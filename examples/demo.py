@@ -1,0 +1,71 @@
+"""End-to-end demo: the Python-API equivalent of the reference's Colab
+notebook (`/root/reference/colab-example-waternet.ipynb`).
+
+The notebook flow was: torchhub load -> fetch an example image -> resize
+720x480 -> preprocess / forward / postprocess -> side-by-side plot. Here:
+
+    python examples/demo.py [--image path] [--weights path] [--out out.png]
+
+With no --image, a synthetic underwater scene is generated (zero-egress
+environments have no wikimedia). With no --weights, the model runs randomly
+initialized (still demonstrates the full pipeline; outputs are obviously
+untrained).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+import numpy as np
+
+# Allow `python examples/demo.py` from a source checkout without install.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--image", type=str, help="Input image (any cv2-readable format)")
+    p.add_argument("--weights", type=str, help="WaterNet weights (.npz or reference .pt)")
+    p.add_argument("--out", type=str, default="demo-out.png")
+    p.add_argument("--size", type=int, nargs=2, default=(720, 480), metavar=("W", "H"))
+    args = p.parse_args()
+
+    import cv2
+
+    from waternet_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    from waternet_tpu.hub import waternet
+
+    if args.image:
+        bgr = cv2.imread(args.image)
+        rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+    else:
+        from waternet_tpu.data.synthetic import SyntheticPairs
+
+        rgb, _ = SyntheticPairs(1, args.size[1], args.size[0], seed=7).load_pair(0)
+        print("No --image given; using a synthetic underwater scene.")
+
+    rgb = cv2.resize(rgb, tuple(args.size))
+
+    try:
+        preprocess, postprocess, model = waternet(
+            pretrained=True, weights=args.weights
+        )
+    except FileNotFoundError:
+        print("No pretrained weights found; demonstrating with random init.")
+        preprocess, postprocess, model = waternet(pretrained=False)
+
+    rgb_t, wb_t, he_t, gc_t = preprocess(rgb)
+    out = model(rgb_t, wb_t, he_t, gc_t)
+    out_im = postprocess(out)[0]
+
+    side_by_side = np.concatenate([rgb, out_im], axis=1)
+    cv2.imwrite(args.out, cv2.cvtColor(side_by_side, cv2.COLOR_RGB2BGR))
+    print(f"Wrote before|after composite to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,131 @@
+"""Scoring CLI: evaluate a checkpoint on the UIEB validation split.
+
+Behavior parity with the reference scorer (`/root/reference/score.py:84-177`):
+same seed-0 800/90 split (reproduced exactly via the torch RNG stream — see
+:func:`waternet_tpu.data.uieb.reference_split`), same 112x112 default eval
+resolution, required ``--weights``, pprinted metric dict (mse / ssim / psnr /
+perceptual_loss averaged equal-weight over val minibatches).
+
+Notes carried over from the survey of the reference:
+* it scores only the 90-image validation split, despite the README calling
+  it "the UIEB dataset" — we keep that but make it explicit via ``--split``;
+* the reference's eval accumulates perceptual_loss with ``=`` instead of
+  ``+=`` (`/root/reference/score.py` copy of `train.py:71`), i.e. it reports
+  only the last batch's value divided by the batch count. That defect is
+  fixed here; pass ``--bug-compat-perceptual`` to reproduce the reference
+  number exactly.
+* host (cv2) preprocessing is the default for parity-grade numbers; use
+  ``--device-preprocess`` for speed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+from pprint import pprint
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Score WaterNet weights on UIEB")
+    p.add_argument("--weights", type=str, required=True, help="Checkpoint (.npz native or reference .pt)")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--height", type=int, default=112)
+    p.add_argument("--width", type=int, default=112)
+    p.add_argument("--data-root", type=str, default="data")
+    p.add_argument("--val-size", type=int, default=90)
+    p.add_argument("--split", type=str, default="val", choices=["val", "train", "all"],
+                   help="Which part of the seed-0 split to score (reference: val)")
+    p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual metric")
+    p.add_argument("--precision", type=str, default="fp32", choices=["bf16", "fp32"])
+    p.add_argument("--device-preprocess", action="store_true")
+    p.add_argument("--bug-compat-perceptual", action="store_true",
+                   help="Reproduce the reference's perceptual_loss accumulation bug")
+    p.add_argument("--json-out", type=str, help="Also write metrics to this JSON file")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    t0 = time.perf_counter()
+
+    from waternet_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    from waternet_tpu.data.uieb import UIEBDataset, reference_split
+    from waternet_tpu.hub import resolve_weights
+    from waternet_tpu.models.vgg import resolve_vgg_params
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    data_root = Path(args.data_root)
+    dataset = UIEBDataset(
+        data_root / "raw-890",
+        data_root / "reference-890",
+        im_height=args.height,
+        im_width=args.width,
+    )
+    train_idx, val_idx = reference_split(len(dataset), n_val=args.val_size)
+    indices = {"val": val_idx, "train": train_idx,
+               "all": np.arange(len(dataset))}[args.split]
+
+    params = resolve_weights(args.weights)
+    if params is None:
+        raise FileNotFoundError(f"could not load weights from {args.weights}")
+
+    config = TrainConfig(
+        batch_size=args.batch_size,
+        im_height=args.height,
+        im_width=args.width,
+        precision=args.precision,
+        host_preprocess=not args.device_preprocess,
+        augment=False,
+    )
+    engine = TrainingEngine(
+        config, params=params, vgg_params=resolve_vgg_params(args.vgg_weights)
+    )
+
+    if args.bug_compat_perceptual:
+        metrics = _eval_bug_compat(engine, dataset, indices, args.batch_size)
+    else:
+        metrics = engine.eval_epoch(
+            dataset.batches(indices, args.batch_size, shuffle=False)
+        )
+
+    pprint(metrics)
+    print(f"Scored {len(indices)} images in {time.perf_counter() - t0:.1f}s")
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(metrics, f, indent=2)
+
+
+def _eval_bug_compat(engine, dataset, indices, batch_size):
+    """Reference `train.py:71`: perceptual_loss is overwritten per batch, so
+    the reported value is last_batch_perceptual / n_batches."""
+    sums = {"mse": 0.0, "ssim": 0.0, "psnr": 0.0}
+    last_perc = 0.0
+    count = 0
+    for raw, ref in dataset.batches(indices, batch_size, shuffle=False):
+        raw, ref, n_real = engine._pad_batch(raw, ref)
+        if engine.config.host_preprocess:
+            tensors = engine._host_preprocess_batch(raw, ref, None)
+            m = engine.eval_step_pre(engine.state, *tensors, n_real)
+        else:
+            import jax.numpy as jnp
+
+            m = engine.eval_step(
+                engine.state, jnp.asarray(raw), jnp.asarray(ref), n_real
+            )
+        for k in sums:
+            sums[k] += float(m[k])
+        last_perc = float(m["perceptual_loss"])
+        count += 1
+    out = {k: v / max(count, 1) for k, v in sums.items()}
+    out["perceptual_loss"] = last_perc / max(count, 1)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Test configuration: run everything on a virtual 8-device CPU platform.
+
+Two subtleties on TPU-attached hosts (e.g. the axon-tunneled CI image):
+
+* a sitecustomize may import jax and register a TPU PJRT plugin before
+  conftest runs, so setting ``JAX_PLATFORMS`` here is too late to stop the
+  plugin's *registration* — and jax initializes every registered backend on
+  first ``jax.devices()``, which dials the TPU tunnel even for CPU runs.
+  Deregistering the factories before the first backend init keeps the test
+  suite fully host-local (and leaves the real TPU free for bench jobs);
+* ``XLA_FLAGS`` must carry the forced device count before that first init.
+
+Sharding/mesh tests then see 8 CPU devices without TPU hardware.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# Pop only the tunneled plugin: removing core platforms (tpu/cuda) breaks
+# MLIR's known-platform registry for lowering registration.
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="session")
+def sample_rgb(rng):
+    """A synthetic underwater-ish uint8 RGB image (non-square to catch HW swaps)."""
+    h, w = 96, 128
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    base = np.stack(
+        [
+            40 + 30 * np.sin(xx / 17.0) + 20 * np.cos(yy / 11.0),
+            90 + 50 * np.sin(xx / 23.0 + 1.0) + 25 * np.cos(yy / 7.0),
+            120 + 60 * np.sin(xx / 13.0 + 2.0) + 30 * np.cos(yy / 19.0),
+        ],
+        axis=-1,
+    )
+    noise = rng.normal(0, 12, size=(h, w, 3))
+    return np.clip(base + noise, 0, 255).astype(np.uint8)

@@ -1,0 +1,21 @@
+"""Load the reference implementation's pure-NumPy/cv2 transform module for
+golden parity tests.
+
+The reference (`/root/reference/waternet/data.py`) depends only on numpy +
+cv2, so it can be imported without torch. Tests that use it are skipped when
+the reference tree is absent (e.g. running the framework standalone).
+"""
+
+import importlib.util
+from pathlib import Path
+
+REFERENCE_DATA = Path("/root/reference/waternet/data.py")
+
+
+def load_reference_data_module():
+    if not REFERENCE_DATA.exists():
+        return None
+    spec = importlib.util.spec_from_file_location("reference_waternet_data", REFERENCE_DATA)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -1,0 +1,118 @@
+"""Data-layer tests: split determinism, batching, augmentation, synthetic data."""
+
+import numpy as np
+import pytest
+
+from waternet_tpu.data.augment import augment_pair_batch, augment_pair_np
+from waternet_tpu.data.synthetic import SyntheticPairs
+from waternet_tpu.data.uieb import UIEBDataset, reference_split
+
+
+def test_reference_split_deterministic():
+    t1, v1 = reference_split(890)
+    t2, v2 = reference_split(890)
+    np.testing.assert_array_equal(t1, t2)
+    np.testing.assert_array_equal(v1, v2)
+    assert len(t1) == 800 and len(v1) == 90
+    assert len(np.intersect1d(t1, v1)) == 0
+    assert len(np.union1d(t1, v1)) == 890
+
+
+def test_reference_split_matches_torch_stream():
+    torch = pytest.importorskip("torch")
+    g = torch.Generator()
+    g.manual_seed(0)
+    perm = torch.randperm(890, generator=g).numpy()
+    t, v = reference_split(890)
+    np.testing.assert_array_equal(t, perm[:800])
+    np.testing.assert_array_equal(v, perm[800:])
+
+
+def test_synthetic_pairs_deterministic_and_shaped():
+    ds = SyntheticPairs(8, 48, 64, seed=3)
+    raw1, ref1 = ds.load_pair(0)
+    raw2, ref2 = SyntheticPairs(8, 48, 64, seed=3).load_pair(0)
+    np.testing.assert_array_equal(raw1, raw2)
+    assert raw1.shape == (48, 64, 3) and raw1.dtype == np.uint8
+    # raw is degraded: red channel should be dimmer than reference's.
+    assert raw1[..., 0].mean() < ref1[..., 0].mean()
+
+
+def test_batches_iteration_and_shuffle():
+    ds = SyntheticPairs(10, 16, 16, seed=0)
+    idx = np.arange(10)
+    b1 = list(ds.batches(idx, 4, shuffle=True, seed=1, epoch=0))
+    assert [b[0].shape[0] for b in b1] == [4, 4, 2]
+    b2 = list(ds.batches(idx, 4, shuffle=True, seed=1, epoch=0))
+    for (r1, _), (r2, _) in zip(b1, b2):
+        np.testing.assert_array_equal(r1, r2)  # same epoch -> same order
+    b3 = list(ds.batches(idx, 4, shuffle=True, seed=1, epoch=1))
+    assert any(
+        not np.array_equal(a[0], b[0]) for a, b in zip(b1, b3)
+    )  # different epoch -> different order
+    b4 = list(ds.batches(idx, 4, shuffle=False, drop_remainder=True))
+    assert [b[0].shape[0] for b in b4] == [4, 4]
+
+
+def test_uieb_dataset_from_disk(tmp_path):
+    import cv2
+
+    raw_dir = tmp_path / "raw"
+    ref_dir = tmp_path / "ref"
+    raw_dir.mkdir()
+    ref_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for name in ["a.png", "b.png"]:
+        cv2.imwrite(str(raw_dir / name), rng.integers(0, 255, (40, 50, 3), dtype=np.uint8))
+        cv2.imwrite(str(ref_dir / name), rng.integers(0, 255, (40, 50, 3), dtype=np.uint8))
+
+    ds = UIEBDataset(raw_dir, ref_dir, im_height=32, im_width=48)
+    assert len(ds) == 2
+    raw, ref = ds.load_pair(0)
+    assert raw.shape == (32, 48, 3) and ref.shape == (32, 48, 3)
+    # cache hit returns identical arrays
+    raw2, _ = ds.load_pair(0)
+    assert raw2 is raw
+
+    # multiple-of-32 fallback sizing
+    ds2 = UIEBDataset(raw_dir, ref_dir)
+    raw3, _ = ds2.load_pair(0)
+    assert raw3.shape == (32, 32, 3)  # 40->32, 50->32
+
+    with pytest.raises(ValueError, match="mismatch"):
+        (ref_dir / "extra.png").write_bytes((raw_dir / "a.png").read_bytes())
+        UIEBDataset(raw_dir, ref_dir)
+
+
+def test_augment_device_preserves_pairing():
+    import jax
+
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (8, 16, 16, 3)).astype(np.float32)
+    ref = raw + 1.0  # pairing marker: ref = raw + 1 everywhere
+    raw_a, ref_a = augment_pair_batch(jax.random.PRNGKey(0), raw, ref)
+    np.testing.assert_allclose(np.asarray(ref_a) - np.asarray(raw_a), 1.0)
+    # augmented batch should differ from input for at least one sample
+    assert not np.array_equal(np.asarray(raw_a), raw)
+    # pixel multiset preserved per image
+    for i in range(8):
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(raw_a)[i].ravel()), np.sort(raw[i].ravel())
+        )
+
+
+def test_augment_host_preserves_pairing():
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (8, 16, 16, 3), dtype=np.uint8)
+    ref = raw.copy()
+    raw_a, ref_a = augment_pair_np(np.random.default_rng(1), raw, ref)
+    np.testing.assert_array_equal(raw_a, ref_a)
+    assert not np.array_equal(raw_a, raw)
+
+
+def test_augment_nonsquare_shape_preserved():
+    import jax
+
+    raw = np.random.default_rng(0).random((4, 12, 20, 3)).astype(np.float32)
+    raw_a, _ = augment_pair_batch(jax.random.PRNGKey(1), raw, raw)
+    assert raw_a.shape == raw.shape

@@ -1,0 +1,152 @@
+"""Training-layer tests: metrics vs torch implementations where available,
+loss semantics, LR schedule semantics, and a tiny end-to-end training run.
+
+The VGG perceptual path is exercised at minimal size (compile cost on the
+1-core CPU CI host); the full-size path runs on TPU in bench/train.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from waternet_tpu.training.losses import mse_255
+from waternet_tpu.training.metrics import psnr, ssim
+from waternet_tpu.training.trainer import TrainConfig, TrainingEngine, make_optimizer
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+def test_psnr_known_value():
+    a = jnp.zeros((1, 8, 8, 3))
+    b = jnp.full((1, 8, 8, 3), 0.1)
+    # mse = 0.01 -> psnr = 10*log10(1/0.01) = 20
+    np.testing.assert_allclose(float(psnr(a, b)), 20.0, atol=1e-4)
+
+
+def test_ssim_identical_is_one():
+    x = jnp.asarray(np.random.default_rng(0).random((2, 32, 32, 3)), jnp.float32)
+    assert float(ssim(x, x, data_range=1.0)) > 0.9999
+
+
+def test_ssim_decreases_with_noise():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((1, 32, 32, 3)), jnp.float32)
+    y1 = x + jnp.asarray(rng.normal(0, 0.01, x.shape), jnp.float32)
+    y2 = x + jnp.asarray(rng.normal(0, 0.1, x.shape), jnp.float32)
+    assert float(ssim(x, y1)) > float(ssim(x, y2))
+
+
+def test_metrics_match_torchmetrics_if_available():
+    tm = pytest.importorskip("torchmetrics")
+    import torch
+
+    rng = np.random.default_rng(3)
+    a = rng.random((2, 16, 16, 3)).astype(np.float32)
+    b = rng.random((2, 16, 16, 3)).astype(np.float32)
+    ta = torch.from_numpy(a.transpose(0, 3, 1, 2))
+    tb = torch.from_numpy(b.transpose(0, 3, 1, 2))
+
+    want_ssim = float(
+        tm.functional.structural_similarity_index_measure(preds=ta, target=tb)
+    )
+    want_psnr = float(
+        tm.functional.peak_signal_noise_ratio(preds=ta, target=tb, data_range=1.0)
+    )
+    np.testing.assert_allclose(float(ssim(a, b)), want_ssim, atol=1e-4)
+    np.testing.assert_allclose(float(psnr(a, b)), want_psnr, atol=1e-4)
+
+
+def test_mse_255_scale():
+    a = jnp.zeros((1, 4, 4, 3))
+    b = jnp.full((1, 4, 4, 3), 1.0 / 255.0)
+    np.testing.assert_allclose(float(mse_255(a, b)), 1.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / schedule
+# ---------------------------------------------------------------------------
+
+
+def test_lr_schedule_staircase_per_minibatch():
+    """StepLR(10000, 0.1) stepped per minibatch (`train.py:251,133`)."""
+    import optax
+
+    cfg = TrainConfig()
+    schedule = optax.exponential_decay(
+        cfg.lr, cfg.lr_step, cfg.lr_gamma, staircase=True
+    )
+    np.testing.assert_allclose(float(schedule(0)), 1e-3)
+    np.testing.assert_allclose(float(schedule(9999)), 1e-3)
+    np.testing.assert_allclose(float(schedule(10000)), 1e-4, rtol=1e-6)
+    np.testing.assert_allclose(float(schedule(20000)), 1e-5, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end tiny training
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = TrainConfig(
+        batch_size=4,
+        im_height=32,
+        im_width=32,
+        precision="fp32",
+        perceptual_weight=0.0,  # skip VGG: compile cost on 1-core CPU host
+    )
+    return TrainingEngine(cfg)
+
+
+def _tiny_batches(n=2, hw=32, bs=4):
+    """Correlated raw/ref pairs (synthetic underwater degradation) — random
+    uniform-noise targets make tiny-run loss curves meaningless."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    ds = SyntheticPairs(n * bs, hw, hw, seed=0)
+    return list(ds.batches(np.arange(n * bs), bs, shuffle=False))
+
+
+def test_train_loss_decreases(tiny_engine):
+    batches = _tiny_batches(1)
+    losses = []
+    for _ in range(10):
+        m = tiny_engine.train_epoch(iter(batches), epoch=0)  # same data, same aug
+        losses.append(m["loss"])
+    assert min(losses[-3:]) < losses[0], losses
+
+
+def test_train_metrics_finite(tiny_engine):
+    m = tiny_engine.train_epoch(iter(_tiny_batches(2)), epoch=1)
+    for k, v in m.items():
+        assert np.isfinite(v), (k, v)
+    assert set(m) == {"mse", "ssim", "psnr", "perceptual_loss", "loss"}
+
+
+def test_eval_metrics(tiny_engine):
+    m = tiny_engine.eval_epoch(iter(_tiny_batches(2)))
+    assert set(m) == {"mse", "ssim", "psnr", "perceptual_loss"}
+    assert np.isfinite(m["mse"])
+
+
+def test_checkpoint_restore_roundtrip(tiny_engine, tmp_path):
+    tiny_engine.train_epoch(iter(_tiny_batches(1)), epoch=0)
+    step_before = int(tiny_engine.state.step)
+    params_before = jax.device_get(tiny_engine.state.params)
+    tiny_engine.checkpoint(tmp_path / "ckpt")
+
+    cfg = TrainConfig(
+        batch_size=4, im_height=32, im_width=32,
+        precision="fp32", perceptual_weight=0.0,
+    )
+    fresh = TrainingEngine(cfg)
+    fresh.restore(tmp_path / "ckpt")
+    assert int(fresh.state.step) == step_before
+    for a, b in zip(
+        jax.tree.leaves(params_before), jax.tree.leaves(fresh.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

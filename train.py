@@ -1,0 +1,204 @@
+"""WaterNet training CLI.
+
+Flag-compatible with the reference trainer (`/root/reference/train.py:163-194`):
+``--epochs --batch-size --height --width --weights --seed`` with identical
+defaults (400 epochs, batch 16, 112x112), the same auto-numbered
+``training/<n>`` run dirs, per-epoch ``last`` checkpoint, and the same
+``metrics-train.csv`` / ``metrics-val.csv`` / ``config.json`` artifacts
+(`train.py:305-348`).
+
+TPU-native additions:
+* ``--precision {bf16,fp32}`` (default bf16: fp32 params, bf16 compute);
+* ``--data-root`` instead of hard-coded paths (defaults to ``data/`` like the
+  reference, `train.py:227-229`);
+* ``--vgg-weights`` to point at torchvision VGG19 weights for the perceptual
+  loss (auto-converted; falls back to random features with a warning);
+* ``--host-preprocess`` for bit-exact cv2 preprocessing (slow path);
+* ``--no-shuffle`` restores the reference's unshuffled loader
+  (`train.py:234` — a reference defect kept available for bug-compat);
+* ``--resume`` restores params + Adam moments + LR-schedule position from an
+  Orbax checkpoint (the reference's resume silently reset both,
+  `train.py:243-245`).
+* synthetic-data fallback: with no dataset on disk, ``--synthetic N`` trains
+  on procedurally generated pairs (CI / bench environments).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="Train WaterNet on TPU")
+    p.add_argument("--epochs", type=int, default=400, help="Num epochs (default 400)")
+    p.add_argument("--batch-size", type=int, default=16, help="Batch size (default 16)")
+    p.add_argument("--height", type=int, default=112, help="Image height (default 112)")
+    p.add_argument("--width", type=int, default=112, help="Image width (default 112)")
+    p.add_argument("--weights", type=str, help="Starting weights (.npz or reference .pt)")
+    p.add_argument("--seed", type=int, default=0, help="Seed (default 0)")
+    p.add_argument("--data-root", type=str, default="data", help="Dataset root containing raw-890/ and reference-890/")
+    p.add_argument("--val-size", type=int, default=90, help="Validation split size (default 90)")
+    p.add_argument("--precision", type=str, default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual loss")
+    p.add_argument("--no-perceptual", action="store_true", help="Disable the VGG perceptual term")
+    p.add_argument("--host-preprocess", action="store_true", help="cv2/NumPy WB+GC+CLAHE on host (bit-exact, slow)")
+    p.add_argument("--no-shuffle", action="store_true", help="Reference bug-compat: no train shuffling")
+    p.add_argument("--no-augment", action="store_true", help="Disable flips/rot90 augmentation")
+    p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from")
+    p.add_argument("--synthetic", type=int, default=0, metavar="N", help="Train on N synthetic pairs instead of reading a dataset")
+    p.add_argument("--profile-dir", type=str, help="Capture a jax.profiler trace of epoch 1 into this dir")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    start_ts = time.perf_counter()
+    projectroot = Path(__file__).parent
+
+    from waternet_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    import jax
+
+    from waternet_tpu.data.uieb import UIEBDataset, reference_split
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.models.vgg import resolve_vgg_params
+    from waternet_tpu.training.trainer import (
+        TRAIN_METRICS_NAMES,
+        VAL_METRICS_NAMES,
+        TrainConfig,
+        TrainingEngine,
+    )
+    from waternet_tpu.utils.checkpoint import save_weights
+    from waternet_tpu.utils.rundir import next_run_dir
+
+    print(f"Devices: {jax.devices()}")
+
+    config = TrainConfig(
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        im_height=args.height,
+        im_width=args.width,
+        precision=args.precision,
+        shuffle=not args.no_shuffle,
+        seed=args.seed,
+        augment=not args.no_augment,
+        perceptual_weight=0.0 if args.no_perceptual else 0.05,
+        host_preprocess=args.host_preprocess,
+    )
+
+    # --- data ---
+    if args.synthetic:
+        dataset = SyntheticPairs(
+            args.synthetic, args.height, args.width, seed=args.seed
+        )
+        all_idx = np.arange(len(dataset))
+        n_val = max(1, min(args.val_size, len(dataset) // 8))
+        train_idx, val_idx = all_idx[:-n_val], all_idx[-n_val:]
+    else:
+        data_root = Path(args.data_root)
+        dataset = UIEBDataset(
+            data_root / "raw-890",
+            data_root / "reference-890",
+            im_height=args.height,
+            im_width=args.width,
+        )
+        train_idx, val_idx = reference_split(len(dataset), n_val=args.val_size)
+
+    # --- engine ---
+    params = None
+    if args.weights:
+        from waternet_tpu.hub import resolve_weights
+
+        params = resolve_weights(args.weights)
+    vgg_params = None if args.no_perceptual else resolve_vgg_params(args.vgg_weights)
+    engine = TrainingEngine(config, params=params, vgg_params=vgg_params)
+    if args.resume:
+        engine.restore(args.resume)
+
+    savedir = next_run_dir(projectroot / "training")
+    saved_train = {k: [] for k in TRAIN_METRICS_NAMES}
+    saved_val = {k: [] for k in VAL_METRICS_NAMES}
+
+    for epoch in range(args.epochs):
+        if args.profile_dir and epoch == 1:
+            jax.profiler.start_trace(args.profile_dir)
+        t0 = time.perf_counter()
+        train_metrics = engine.train_epoch(
+            dataset.batches(
+                train_idx,
+                config.batch_size,
+                shuffle=config.shuffle,
+                seed=config.seed,
+                epoch=epoch,
+            ),
+            epoch=epoch,
+        )
+        train_dt = time.perf_counter() - t0
+        val_metrics = engine.eval_epoch(
+            dataset.batches(val_idx, config.batch_size, shuffle=False)
+        )
+        dt = time.perf_counter() - t0
+        if args.profile_dir and epoch == 1:
+            jax.profiler.stop_trace()
+
+        ips = len(train_idx) / train_dt
+        print(
+            f"Epoch {epoch + 1}/{args.epochs} "
+            f"[train {train_dt:.1f}s + val {dt - train_dt:.1f}s, {ips:.1f} img/s]"
+        )
+        print(
+            "    Train ||",
+            "   ".join(f"{k}: {v:.03g}" for k, v in train_metrics.items()),
+        )
+        print(
+            "    Val   ||",
+            "   ".join(f"{k}: {v:.03g}" for k, v in val_metrics.items()),
+        )
+
+        for k, v in train_metrics.items():
+            saved_train[k].append(v)
+        for k, v in val_metrics.items():
+            saved_val[k].append(v)
+
+        # Savedir created as late as possible (reference `train.py:303-306`).
+        savedir.mkdir(parents=True, exist_ok=True)
+        save_weights(engine.state.params, savedir / "last.npz")
+        engine.checkpoint(savedir / "state")
+
+    train_arr = np.stack([np.asarray(saved_train[k]) for k in TRAIN_METRICS_NAMES], 1)
+    val_arr = np.stack([np.asarray(saved_val[k]) for k in VAL_METRICS_NAMES], 1)
+    np.savetxt(
+        savedir / "metrics-train.csv", train_arr, fmt="%f", delimiter=",",
+        comments="", header=",".join(TRAIN_METRICS_NAMES),
+    )
+    np.savetxt(
+        savedir / "metrics-val.csv", val_arr, fmt="%f", delimiter=",",
+        comments="", header=",".join(VAL_METRICS_NAMES),
+    )
+    with open(savedir / "config.json", "w") as f:
+        json.dump(
+            {
+                "epochs": args.epochs,
+                "batch_size": args.batch_size,
+                "im_height": args.height,
+                "im_width": args.width,
+                "weights": args.weights,
+                "precision": args.precision,
+                "shuffle": config.shuffle,
+                "augment": config.augment,
+            },
+            f,
+            indent=4,
+        )
+    print(f"Metrics and weights saved to {savedir}")
+    print(f"Total time: {time.perf_counter() - start_ts}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""waternet_tpu — a TPU-native underwater image enhancement framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of tnwei/waternet
+(PyTorch reference, see /root/reference): a gated-fusion fully-convolutional
+network (WaterNet, IEEE TIP 2019) with training, scoring, and image/video
+inference — built TPU-first:
+
+* NHWC tensors end-to-end (TPU-preferred layout).
+* Classical preprocessing ops (white balance, gamma, CLAHE) implemented as
+  batched, jittable JAX so they run fused with the model on-device instead of
+  serializing on the host CPU (the reference's main throughput limiter).
+* One jitted train step: augment -> preprocess -> forward -> VGG perceptual
+  loss -> backward -> Adam -> on-device SSIM/PSNR.
+* Data parallelism via `jax.sharding.Mesh` + NamedSharding, and *spatial*
+  sharding (the context-parallelism analog for an FCN) via `shard_map` with
+  ppermute halo exchange.
+
+Public API mirrors the reference's torchhub contract
+(`hubconf.py:37-96` in the reference): ``preprocess, postprocess, model``.
+"""
+
+__version__ = "0.1.0"
+
+# Lazy re-exports (PEP 562): importing `waternet_tpu.utils.platform` (or any
+# other submodule) must not drag in jax-heavy modules before a CLI has had
+# the chance to pick a platform.
+_EXPORTS = {
+    "transform": "waternet_tpu.ops",
+    "WaterNet": "waternet_tpu.models",
+    "waternet": "waternet_tpu.hub",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        module = importlib.import_module(_EXPORTS[name])
+        return getattr(module, name)
+    raise AttributeError(f"module 'waternet_tpu' has no attribute {name!r}")

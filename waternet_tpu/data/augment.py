@@ -1,0 +1,90 @@
+"""On-device paired augmentation.
+
+Policy from the reference (`/root/reference/waternet/training_utils.py:72-78`,
+approximating the paper's 7-fold flip/rotate augmentation):
+``HorizontalFlip(p=0.5)``, ``VerticalFlip(p=0.5)``, ``RandomRotate90(p=0.5)``
+(rotation count uniform in {0,1,2,3} when applied), applied identically to
+the raw image and its reference (albumentations image/mask pairing,
+`training_utils.py:109-111`).
+
+Runs inside the jitted train step on uint8-valued tensors *before* the
+WB/GC/CLAHE transforms — same order as the reference (augment first, then
+transform, `training_utils.py:109-116`), which matters because CLAHE tiles
+do not commute with flips.
+
+90/270-degree rotations change the static shape unless H == W, so for
+non-square batches the rotation component degrades to 180-only (the
+reference's default train shapes are square: 112 or 256).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _apply_one(img, hflip, vflip, rotk):
+    """img: (H, W, C) float32; flags/rotk: scalars. Shape-preserving."""
+    img = jnp.where(hflip, img[:, ::-1, :], img)
+    img = jnp.where(vflip, img[::-1, :, :], img)
+    square = img.shape[0] == img.shape[1]
+    if square:
+        branches = [
+            lambda v: v,
+            lambda v: jnp.rot90(v, 1, axes=(0, 1)),
+            lambda v: jnp.rot90(v, 2, axes=(0, 1)),
+            lambda v: jnp.rot90(v, 3, axes=(0, 1)),
+        ]
+        img = lax.switch(rotk, branches, img)
+    else:
+        img = jnp.where(rotk == 2, jnp.rot90(img, 2, axes=(0, 1)), img)
+    return img
+
+
+def augment_pair_np(rng, raw, ref):
+    """Host (NumPy) version of the same policy, for the host-preprocess path.
+
+    raw/ref: (N, H, W, C) uint8 arrays; rng: np.random.Generator.
+    """
+    import numpy as np
+
+    raw = np.array(raw, copy=True)
+    ref = np.array(ref, copy=True)
+    n = raw.shape[0]
+    square = raw.shape[1] == raw.shape[2]
+    for i in range(n):
+        if rng.random() < 0.5:
+            raw[i] = raw[i][:, ::-1]
+            ref[i] = ref[i][:, ::-1]
+        if rng.random() < 0.5:
+            raw[i] = raw[i][::-1]
+            ref[i] = ref[i][::-1]
+        if rng.random() < 0.5:
+            k = int(rng.integers(0, 4))
+            if not square:
+                k = 2 if k in (1, 2, 3) else 0
+            raw[i] = np.rot90(raw[i], k, axes=(0, 1))
+            ref[i] = np.rot90(ref[i], k, axes=(0, 1))
+    return raw, ref
+
+
+def augment_pair_batch(rng: jax.Array, raw: jnp.ndarray, ref: jnp.ndarray):
+    """Paired random flips/rot90 for an (N, H, W, C) batch.
+
+    Returns (raw_aug, ref_aug) float32 with the same uint8 values.
+    """
+    n = raw.shape[0]
+    k_h, k_v, k_r, k_rk = jax.random.split(rng, 4)
+    hflip = jax.random.bernoulli(k_h, 0.5, (n,))
+    vflip = jax.random.bernoulli(k_v, 0.5, (n,))
+    # RandomRotate90(p=0.5): apply with prob 0.5; when applied k ~ U{0..3}.
+    do_rot = jax.random.bernoulli(k_r, 0.5, (n,))
+    rotk = jnp.where(
+        do_rot, jax.random.randint(k_rk, (n,), 0, 4), 0
+    ).astype(jnp.int32)
+
+    raw = raw.astype(jnp.float32)
+    ref = ref.astype(jnp.float32)
+    aug = jax.vmap(_apply_one)
+    return aug(raw, hflip, vflip, rotk), aug(ref, hflip, vflip, rotk)

@@ -1,0 +1,32 @@
+"""Shared epoch batch iterator for map-style pair datasets."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Tuple
+
+import numpy as np
+
+
+def iter_batches(
+    load_pair: Callable[[int], Tuple[np.ndarray, np.ndarray]],
+    indices,
+    batch_size: int,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_remainder: bool = False,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield (raw_u8, ref_u8) NHWC uint8 batches for one epoch.
+
+    Shuffle order is a deterministic function of (seed, epoch) via Philox, so
+    epochs are reproducible and resume replays the same order.
+    """
+    order = np.array(indices, copy=True)
+    if shuffle:
+        np.random.Generator(np.random.Philox(key=seed + 7919 * epoch)).shuffle(order)
+    n = len(order)
+    stop = n - n % batch_size if drop_remainder else n
+    for start in range(0, stop, batch_size):
+        chunk = order[start : start + batch_size]
+        raws, refs = zip(*(load_pair(int(i)) for i in chunk))
+        yield np.stack(raws), np.stack(refs)

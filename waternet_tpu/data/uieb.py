@@ -1,0 +1,129 @@
+"""UIEB paired dataset + deterministic split + host-side batch iterator.
+
+Capability spec from the reference (`/root/reference/waternet/training_utils.py:46-132`,
+`/root/reference/train.py:227-235`):
+
+* pairs ``*.png`` files by name across a raw dir and a reference dir
+  (asserting name parity);
+* resizes to (width, height), or to the nearest multiple of 32 when no size
+  given (VGG constraint);
+* BGR -> RGB;
+* paired augmentation (hflip/vflip/rot90, each p=0.5);
+* per-item WB/GC/CLAHE transforms;
+* an implicit seed-0 800/90 random split shared between train.py and
+  score.py.
+
+TPU-first redesign:
+
+* **Decode once, cache uint8**: the reference re-reads and re-decodes every
+  image every epoch inside ``__getitem__`` with a single-process loader —
+  at 112x112 the whole 890-pair dataset is ~67 MB of uint8, so we decode and
+  resize once into a RAM cache and every later epoch is pure array indexing.
+* **Augmentation and WB/GC/CLAHE run on-device** inside the jitted train
+  step (see :mod:`waternet_tpu.data.augment`, :mod:`waternet_tpu.ops`): the
+  host emits raw uint8 batches only. A ``host_preprocess`` mode keeps the
+  bit-exact cv2 path for parity runs.
+* **Explicit split**: :func:`reference_split` reproduces the reference's
+  torch seed-0 ``random_split(dataset, [800, 90])`` exactly when torch is
+  importable (same RNG stream), with a documented numpy fallback. The split
+  is a function argument, not hidden global RNG state
+  (fixes the implicit coupling between `train.py:160,233` and
+  `score.py:89,141`).
+* Shuffling is ON by default (the reference never shuffles —
+  `train.py:234` — which is a defect, not a feature; ``shuffle=False``
+  restores bug-compat).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+def reference_split(
+    n_total: int, n_val: int = 90, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(train_indices, val_indices), matching torch's seed-0 random_split.
+
+    ``torch.utils.data.random_split(ds, [800, 90])`` under
+    ``torch.manual_seed(0)`` permutes indices with the global torch RNG
+    (`/root/reference/train.py:160,233`); we reproduce that stream via torch
+    when available so reference-trained checkpoints score on the identical
+    90 images. Fallback: numpy Philox permutation (documented, not
+    torch-identical).
+    """
+    try:
+        import torch
+
+        g = torch.Generator()
+        g.manual_seed(seed)
+        perm = torch.randperm(n_total, generator=g).numpy()
+    except ImportError:  # pragma: no cover - torch is present in CI image
+        perm = np.random.Generator(np.random.Philox(seed)).permutation(n_total)
+    n_train = n_total - n_val
+    return perm[:n_train], perm[n_train:]
+
+
+class UIEBDataset:
+    """Paired underwater image dataset with uint8 RAM cache."""
+
+    def __init__(
+        self,
+        raw_dir,
+        ref_dir,
+        im_height: Optional[int] = None,
+        im_width: Optional[int] = None,
+        cache: bool = True,
+    ):
+        self.raw_dir = Path(raw_dir)
+        self.ref_dir = Path(ref_dir)
+        raw_names = sorted(p.name for p in self.raw_dir.glob("*.png"))
+        ref_names = sorted(p.name for p in self.ref_dir.glob("*.png"))
+        if set(raw_names) != set(ref_names):
+            raise ValueError(
+                f"raw/ref filename mismatch: {len(raw_names)} raw vs "
+                f"{len(ref_names)} ref pngs"
+            )
+        self.names = raw_names
+        self.im_height = im_height
+        self.im_width = im_width
+        self._cache: dict[int, Tuple[np.ndarray, np.ndarray]] = {} if cache else None
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def _target_size(self, shape) -> Tuple[int, int]:
+        if self.im_width is not None and self.im_height is not None:
+            return self.im_width, self.im_height
+        # Multiple-of-32 fallback for VGG, as `training_utils.py:99-103`
+        # (the reference swaps H/W reading shape[0]/shape[1] into (w, h); we
+        # use the actual axes).
+        h, w = shape[0], shape[1]
+        return (w // 32) * 32, (h // 32) * 32
+
+    def load_pair(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        """-> (raw_rgb_u8, ref_rgb_u8), resized, cached."""
+        if self._cache is not None and idx in self._cache:
+            return self._cache[idx]
+        import cv2
+
+        raw = cv2.imread(str(self.raw_dir / self.names[idx]))
+        ref = cv2.imread(str(self.ref_dir / self.names[idx]))
+        tw, th = self._target_size(raw.shape)
+        raw = cv2.resize(raw, (tw, th))
+        ref = cv2.resize(ref, (tw, th))
+        raw = cv2.cvtColor(raw, cv2.COLOR_BGR2RGB)
+        ref = cv2.cvtColor(ref, cv2.COLOR_BGR2RGB)
+        pair = (raw, ref)
+        if self._cache is not None:
+            self._cache[idx] = pair
+        return pair
+
+    def batches(self, indices, batch_size: int, **kwargs) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield (raw_u8, ref_u8) NHWC uint8 batches for one epoch
+        (see :func:`waternet_tpu.data.batching.iter_batches`)."""
+        from waternet_tpu.data.batching import iter_batches
+
+        return iter_batches(self.load_pair, indices, batch_size, **kwargs)

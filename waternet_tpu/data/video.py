@@ -1,0 +1,61 @@
+"""Pipelined video enhancement.
+
+The reference processes video strictly one frame at a time — decode,
+preprocess, forward, write, repeat (`/root/reference/inference.py:261-323`) —
+so the accelerator idles during every decode and vice versa. Here frames are
+processed in batches with double buffering: while the device runs batch N,
+the host decodes and preprocesses batch N+1 (JAX dispatch is asynchronous, so
+`enhance_async` returns immediately and the host overlaps with device work).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+def _read_batch(cap, batch_size: int):
+    """Read up to batch_size frames; returns (bgr_frames, rgb_array|None)."""
+    import cv2
+
+    frames = []
+    for _ in range(batch_size):
+        ok, bgr = cap.read()
+        if not ok:
+            break
+        frames.append(bgr)
+    if not frames:
+        return [], None
+    rgb = np.stack([cv2.cvtColor(f, cv2.COLOR_BGR2RGB) for f in frames])
+    return frames, rgb
+
+
+def enhance_video_stream(
+    engine, cap, batch_size: int = 4
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield (original_bgr, enhanced_bgr) frame pairs in order.
+
+    ``engine`` is an :class:`waternet_tpu.inference_engine.InferenceEngine`;
+    ``cap`` is an opened cv2.VideoCapture.
+    """
+    import cv2
+
+    prev_frames, prev_rgb = _read_batch(cap, batch_size)
+    if prev_rgb is None:
+        return
+    pending = engine.enhance_async(prev_rgb)
+
+    while True:
+        # Decode the next batch while the device works on `pending`.
+        next_frames, next_rgb = _read_batch(cap, batch_size)
+        from waternet_tpu.utils.tensor import ten2arr
+
+        out = ten2arr(pending)  # sync point for the previous batch
+        if next_rgb is not None:
+            pending = engine.enhance_async(next_rgb)
+        for bgr_in, rgb_out in zip(prev_frames, out):
+            yield bgr_in, cv2.cvtColor(rgb_out, cv2.COLOR_RGB2BGR)
+        if next_rgb is None:
+            return
+        prev_frames = next_frames

@@ -1,0 +1,135 @@
+"""The public Python API: ``preprocess, postprocess, model = waternet(...)``.
+
+Shape-compatible with the reference's torchhub contract
+(`/root/reference/hubconf.py:37-96`): ``preprocess`` maps one uint8 HWC RGB
+array to the 4-tuple ``(rgb, wb, he, gc)`` in exactly the positional order
+the model consumes (`net.py:99` takes ``(x, wb, ce, gc)`` where ce=he), and
+``postprocess`` maps the model output back to uint8. Differences, all
+deliberate and TPU-idiomatic:
+
+* tensors are NHWC jax arrays (not NCHW torch tensors);
+* ``model`` is a jitted pure function closed over the params — it is also
+  exposed unjitted via ``model.apply_fn`` / ``model.params`` for composition;
+* no network download: weights resolve from an explicit path, the
+  ``WATERNET_TPU_WEIGHTS`` env var, a local ``weights/`` dir, or a reference
+  torch checkpoint (auto-converted via
+  :mod:`waternet_tpu.utils.torch_port`); zero-egress environments are the
+  norm on TPU pods, so missing weights raise with instructions instead of
+  downloading.
+
+Example::
+
+    from waternet_tpu.hub import waternet
+    preprocess, postprocess, model = waternet(pretrained=True)
+    rgb = cv2.cvtColor(cv2.imread("example.png"), cv2.COLOR_BGR2RGB)
+    rgb_t, wb_t, he_t, gc_t = preprocess(rgb)
+    out = model(rgb_t, wb_t, he_t, gc_t)     # (1, H, W, 3) float32 in [0,1]
+    out_im = postprocess(out)                # (1, H, W, 3) uint8
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from waternet_tpu.models import WaterNet
+from waternet_tpu.ops import transform_np
+from waternet_tpu.utils.checkpoint import load_weights
+from waternet_tpu.utils.tensor import arr2ten, ten2arr
+
+
+class JittedModel:
+    """Callable wrapper pairing a jitted apply with its params.
+
+    Keeps the reference's ``model(x, wb, ce, gc)`` call shape while exposing
+    the functional pieces (``apply_fn``, ``params``) for jax composition.
+    """
+
+    def __init__(self, module: WaterNet, params):
+        self.module = module
+        self.params = params
+        self.apply_fn = module.apply
+        self._jitted = jax.jit(module.apply)
+
+    def __call__(self, x, wb, ce, gc):
+        return self._jitted(self.params, x, wb, ce, gc)
+
+
+def resolve_weights(weights=None, search_dirs=(".", "weights")) -> dict | None:
+    """Find and load WaterNet weights. Returns a param pytree or None."""
+    candidates = []
+    if weights is not None:
+        candidates.append(Path(weights))
+    env = os.environ.get("WATERNET_TPU_WEIGHTS")
+    if env:
+        candidates.append(Path(env))
+    for d in search_dirs:
+        d = Path(d)
+        if d.is_dir():
+            candidates.extend(sorted(d.glob("waternet_tpu-*.npz")))
+            candidates.extend(sorted(d.glob("waternet_exported_state_dict*.pt")))
+            # Broad fallback, excluding VGG19 perceptual-loss weight files
+            # which share these dirs (see resolve_vgg_params).
+            candidates.extend(
+                p
+                for pat in ("*.npz", "*.pt")
+                for p in sorted(d.glob(pat))
+                if not p.name.lower().startswith("vgg")
+            )
+    for c in candidates:
+        if not c.exists():
+            continue
+        if c.suffix == ".npz":
+            return load_weights(c)
+        if c.suffix in (".pt", ".pth"):
+            from waternet_tpu.utils.torch_port import waternet_params_from_torch
+
+            return waternet_params_from_torch(c)
+    return None
+
+
+def waternet(
+    pretrained: bool = True,
+    weights=None,
+    dtype=jnp.float32,
+) -> Tuple[Callable, Callable, JittedModel]:
+    """Build the (preprocess, postprocess, model) triple.
+
+    Args:
+        pretrained: load weights (from ``weights``/env/local dirs). If none
+            are found, raises with pointers; pass ``pretrained=False`` for a
+            randomly initialized model.
+        weights: optional explicit path (.npz ours, or reference .pt).
+        dtype: compute dtype for the model (bfloat16 recommended on TPU).
+    """
+    from waternet_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    module = WaterNet(dtype=dtype)
+    if pretrained:
+        params = resolve_weights(weights)
+        if params is None:
+            raise FileNotFoundError(
+                "No WaterNet weights found. Provide `weights=...`, set "
+                "WATERNET_TPU_WEIGHTS, or place waternet_tpu-*.npz / the "
+                "reference's waternet_exported_state_dict-*.pt in ./weights. "
+                "(This framework does not download weights: TPU environments "
+                "are commonly egress-less; fetch once and ship the file.)"
+            )
+    else:
+        zeros = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        params = module.init(jax.random.PRNGKey(0), zeros, zeros, zeros, zeros)
+
+    def preprocess(rgb_arr: np.ndarray):
+        wb, gc, he = transform_np(rgb_arr)
+        return arr2ten(rgb_arr), arr2ten(wb), arr2ten(he), arr2ten(gc)
+
+    def postprocess(model_out):
+        return ten2arr(model_out)
+
+    return preprocess, postprocess, JittedModel(module, params)

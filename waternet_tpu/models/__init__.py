@@ -1,0 +1,5 @@
+"""Model layer (L3): Flax modules."""
+
+from waternet_tpu.models.waternet import ConfidenceMapGenerator, Refiner, WaterNet
+
+__all__ = ["ConfidenceMapGenerator", "Refiner", "WaterNet"]

@@ -1,0 +1,27 @@
+"""Classical image ops (L1 layer): white balance, gamma, CLAHE.
+
+Each op has a host path (`*_np`, NumPy/cv2, bit-exact vs the reference's
+`waternet/data.py`) and a device path (pure JAX, jittable/vmappable, designed
+to run fused with the model on TPU).
+"""
+
+from waternet_tpu.ops.clahe import clahe, histeq, histeq_np
+from waternet_tpu.ops.color import lab_u8_to_rgb, rgb_to_lab_u8
+from waternet_tpu.ops.gamma import gamma_correction, gamma_correction_np
+from waternet_tpu.ops.transform import transform, transform_batch, transform_np
+from waternet_tpu.ops.wb import white_balance, white_balance_np
+
+__all__ = [
+    "clahe",
+    "histeq",
+    "histeq_np",
+    "lab_u8_to_rgb",
+    "rgb_to_lab_u8",
+    "gamma_correction",
+    "gamma_correction_np",
+    "transform",
+    "transform_batch",
+    "transform_np",
+    "white_balance",
+    "white_balance_np",
+]

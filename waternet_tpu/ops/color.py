@@ -1,0 +1,90 @@
+"""8-bit RGB <-> CIELAB color conversion in pure JAX.
+
+Needed by the on-device CLAHE path (:mod:`waternet_tpu.ops.clahe`): the
+reference runs CLAHE on the L channel of an OpenCV LAB conversion
+(`/root/reference/waternet/data.py:68-78`).
+
+These functions implement the standard sRGB(D65) <-> CIELAB formulas with
+OpenCV's 8-bit scaling convention (L in [0,255] via *255/100, a/b offset by
++128). OpenCV's uint8 path uses fixed-point interpolation tables, so results
+can differ from this float implementation by ~1 intensity level; the host
+path (cv2) remains the bit-exact-parity default, and the device path is
+tolerance-tested against it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# sRGB D65 forward matrix and whitepoint (as used by OpenCV's Lab code).
+# NumPy (not jnp) on purpose: module-level jnp arrays would initialize the
+# jax backend at import time, before CLIs can pick a platform.
+_RGB2XYZ = np.array(
+    [
+        [0.412453, 0.357580, 0.180423],
+        [0.212671, 0.715160, 0.072169],
+        [0.019334, 0.119193, 0.950227],
+    ],
+    dtype=np.float32,
+)
+_XYZ2RGB = np.array(
+    [
+        [3.240479, -1.537150, -0.498535],
+        [-0.969256, 1.875992, 0.041556],
+        [0.055648, -0.204043, 1.057311],
+    ],
+    dtype=np.float32,
+)
+_WHITE = np.array([0.950456, 1.0, 1.088754], dtype=np.float32)
+_LAB_T0 = 0.008856
+_LAB_K = 7.787
+
+
+def _srgb_to_linear(v):
+    return jnp.where(v > 0.04045, jnp.power((v + 0.055) / 1.055, 2.4), v / 12.92)
+
+
+def _linear_to_srgb(v):
+    return jnp.where(
+        v > 0.0031308, 1.055 * jnp.power(jnp.maximum(v, 0.0), 1.0 / 2.4) - 0.055, 12.92 * v
+    )
+
+
+def _lab_f(t):
+    return jnp.where(t > _LAB_T0, jnp.cbrt(t), _LAB_K * t + 16.0 / 116.0)
+
+
+def _lab_f_inv(f):
+    t3 = f * f * f
+    return jnp.where(t3 > _LAB_T0, t3, (f - 16.0 / 116.0) / _LAB_K)
+
+
+def rgb_to_lab_u8(rgb: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3) uint8-valued RGB -> (..., 3) float32 holding 8-bit LAB values.
+
+    Output channels: L in [0,255] (scaled *255/100), a/b offset by +128 —
+    OpenCV's 8-bit LAB convention, rounded to integers.
+    """
+    x = _srgb_to_linear(rgb.astype(jnp.float32) / 255.0)
+    xyz = x @ _RGB2XYZ.T / _WHITE
+    f = _lab_f(xyz)
+    fx, fy, fz = f[..., 0], f[..., 1], f[..., 2]
+    lum = 116.0 * fy - 16.0
+    a = 500.0 * (fx - fy)
+    b = 200.0 * (fy - fz)
+    lab = jnp.stack([lum * 255.0 / 100.0, a + 128.0, b + 128.0], axis=-1)
+    return jnp.clip(jnp.round(lab), 0.0, 255.0)
+
+
+def lab_u8_to_rgb(lab: jnp.ndarray) -> jnp.ndarray:
+    """(..., 3) float32 8-bit LAB values -> (..., 3) float32 uint8-valued RGB."""
+    lum = lab[..., 0] * 100.0 / 255.0
+    a = lab[..., 1] - 128.0
+    b = lab[..., 2] - 128.0
+    fy = (lum + 16.0) / 116.0
+    f = jnp.stack([fy + a / 500.0, fy, fy - b / 200.0], axis=-1)
+    xyz = _lab_f_inv(f) * _WHITE
+    rgb_lin = xyz @ _XYZ2RGB.T
+    rgb = _linear_to_srgb(rgb_lin)
+    return jnp.clip(jnp.round(rgb * 255.0), 0.0, 255.0)

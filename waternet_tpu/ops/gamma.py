@@ -1,0 +1,35 @@
+"""Gamma correction transform.
+
+Behavioral spec from the reference (`/root/reference/waternet/data.py:61-65`):
+``out = uint8(clip((im/255) ** 0.7 * 255, 0, 255))`` with numpy ``astype``
+truncation.
+
+The input domain is uint8, so the device path is an exact 256-entry lookup
+table (precomputed in float64 on host at trace time) — bit-identical to the
+reference and cheaper on TPU than a transcendental ``pow`` per pixel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+GAMMA = 0.7  # reference `data.py:62`
+
+
+def _lut(gamma: float) -> np.ndarray:
+    levels = np.arange(256, dtype=np.float64)
+    out = np.clip(255.0 * np.power(levels / 255.0, gamma), 0, 255)
+    return out.astype(np.uint8).astype(np.float32)  # truncation, as reference
+
+
+def gamma_correction_np(img: np.ndarray, gamma: float = GAMMA) -> np.ndarray:
+    """Host path. uint8 -> uint8, any shape."""
+    out = np.power(img / 255.0, gamma)
+    return np.clip(255.0 * out, 0, 255).astype(np.uint8)
+
+
+def gamma_correction(img: jnp.ndarray, gamma: float = GAMMA) -> jnp.ndarray:
+    """Device path. uint8-valued array -> float32 exact uint8 values [0, 255]."""
+    lut = jnp.asarray(_lut(gamma))
+    return lut[img.astype(jnp.int32)]

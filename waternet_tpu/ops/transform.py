@@ -1,0 +1,47 @@
+"""The WaterNet preprocessing transform: rgb -> (wb, gc, he).
+
+Mirrors the reference wrapper (`/root/reference/waternet/data.py:81-90`),
+including its return order quirk: the wrapper returns ``(wb, gc, he)`` while
+the model consumes ``(x, wb, he, gc)`` — callers are responsible for the
+reordering, exactly as in the reference
+(`/root/reference/train.py:108`, `/root/reference/hubconf.py:85-91`).
+
+Host path: :func:`transform_np` (NumPy + cv2, bit-exact vs reference).
+Device path: :func:`transform` (pure JAX, jittable) and
+:func:`transform_batch` (vmapped over a leading batch axis) — this is what
+lets preprocessing run fused with the model inside one XLA program instead of
+serializing on the host CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from waternet_tpu.ops.clahe import histeq, histeq_np
+from waternet_tpu.ops.gamma import gamma_correction, gamma_correction_np
+from waternet_tpu.ops.wb import white_balance, white_balance_np
+
+
+def transform_np(rgb: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host path. uint8 HWC RGB -> (wb, gc, he) uint8 HWC."""
+    return white_balance_np(rgb), gamma_correction_np(rgb), histeq_np(rgb)
+
+
+def transform(rgb: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Device path for one image.
+
+    Args:
+        rgb: (H, W, 3) uint8-valued array.
+    Returns:
+        (wb, gc, he): float32 (H, W, 3) arrays holding exact uint8 values
+        in [0, 255] — divide by 255 to feed the network.
+    """
+    return white_balance(rgb), gamma_correction(rgb), histeq(rgb)
+
+
+transform_batch = jax.vmap(transform)
+transform_batch.__doc__ = """Batched device path: (N, H, W, 3) -> 3x (N, H, W, 3) float32."""

@@ -1,0 +1,103 @@
+"""White balance (simplest color balance) transform.
+
+Behavioral spec from the reference implementation
+(`/root/reference/waternet/data.py:6-58`, itself a port of the WaterNet
+authors' MATLAB ``SimplestColorBalance.m``):
+
+For an RGB uint8 HWC image:
+1. Per-channel saturation levels are *dynamic*: ``sat_c = 0.005 * maxsum /
+   sum_c`` where ``sum_c`` is the channel's pixel sum and ``maxsum`` the
+   largest of the three sums (dimmer channels get clipped more aggressively).
+2. Each channel is clipped to its ``[quantile(sat_c), quantile(1 - sat_c)]``
+   range (linear-interpolation quantiles).
+3. Each channel is then min-max stretched to [0, 255] and truncated to uint8
+   (numpy ``astype`` truncates toward zero, i.e. floor for non-negative).
+
+Two implementations:
+* :func:`white_balance_np` — host path, vectorized NumPy. Matches the
+  reference output bit-for-bit (verified by golden tests).
+* :func:`white_balance` — device path, pure JAX, jittable and vmappable.
+  Returns float32 holding exact uint8 values so it can feed the network
+  directly after ``/255`` without a host round-trip.
+
+The reference also has a grayscale branch (`data.py:31-36`) that is unused by
+every caller and mutates its input through a reshape view; we support the
+grayscale case in the host path (without the mutation defect) and only RGB on
+device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_SAT = 0.005  # reference `data.py:22-23`
+
+
+def white_balance_np(img: np.ndarray) -> np.ndarray:
+    """Host-path simplest color balance. uint8 HWC (or HW) -> uint8 same shape."""
+    if img.ndim == 2:
+        flat = img.reshape(1, -1).astype(np.float64)
+        lo_q = np.array([0.001])
+        hi_q = 1.0 - np.array([0.005])
+    else:
+        h, w, c = img.shape
+        flat = img.reshape(h * w, c).T.astype(np.float64)  # (C, H*W)
+        sums = flat.sum(axis=1)
+        # Guard degenerate frames (all-black channel -> 0/0; the reference
+        # crashes here, but video fades make this a real input).
+        sat = _SAT * (sums.max() / np.maximum(sums, 1.0))
+        lo_q, hi_q = np.clip(sat, 0.0, 0.5), 1.0 - np.clip(sat, 0.0, 0.5)
+
+    out = np.empty_like(flat)
+    for ch in range(flat.shape[0]):
+        lo, hi = np.quantile(flat[ch], [lo_q[ch], hi_q[ch]])
+        v = np.clip(flat[ch], lo, hi)
+        if hi > lo:
+            out[ch] = (v - lo) * 255.0 / (hi - lo)
+        else:
+            out[ch] = v  # constant channel: stretch undefined, pass through
+
+    if img.ndim == 2:
+        return out.reshape(img.shape).astype(np.uint8)
+    return out.T.reshape(img.shape).astype(np.uint8)
+
+
+def white_balance(rgb: jnp.ndarray) -> jnp.ndarray:
+    """Device-path simplest color balance for one RGB image.
+
+    Args:
+        rgb: (H, W, 3) uint8 or float32 holding uint8 values.
+
+    Returns:
+        (H, W, 3) float32 with exact uint8 values (floored), range [0, 255].
+
+    Jittable; vmap over a leading batch axis for batched use. Quantiles are
+    computed per image per channel (data-dependent values, static shapes).
+    """
+    x = rgb.astype(jnp.float32)
+    flat = x.reshape(-1, 3)  # (P, 3)
+    sums = flat.sum(axis=0)
+    # Degenerate-frame guards mirror the host path (all-black channels and
+    # constant channels must not emit NaN into the training batch).
+    sat = jnp.clip(_SAT * (sums.max() / jnp.maximum(sums, 1.0)), 0.0, 0.5)
+
+    # Per-channel linear-interpolation quantiles at per-channel probabilities.
+    srt = jnp.sort(flat, axis=0)  # (P, 3)
+    n = flat.shape[0]
+
+    def _q(p):
+        pos = p * (n - 1)
+        i0 = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, n - 1)
+        i1 = jnp.clip(i0 + 1, 0, n - 1)
+        w1 = pos - i0.astype(jnp.float32)
+        a = jnp.take_along_axis(srt, i0[None, :], axis=0)[0]
+        b = jnp.take_along_axis(srt, i1[None, :], axis=0)[0]
+        return a * (1.0 - w1) + b * w1
+
+    lo = _q(sat)
+    hi = _q(1.0 - sat)
+    v = jnp.clip(x, lo, hi)
+    out = jnp.where(hi > lo, (v - lo) * 255.0 / jnp.maximum(hi - lo, 1e-9), v)
+    # Reference truncates via uint8 astype; floor matches for non-negatives.
+    return jnp.floor(out)

@@ -1,0 +1,68 @@
+"""Device mesh + sharding helpers.
+
+The reference is strictly single-device (`/root/reference/train.py:238,247`;
+no torch.distributed anywhere). The TPU-native scaling story instead:
+
+* a 2-D logical mesh ``(data, spatial)`` over whatever devices exist —
+  a single chip, a v4-8 slice, or a multi-host pod (``jax.devices()`` is
+  already global under multi-host jax.distributed initialization);
+* **data axis**: batch sharding for training. Params are replicated; XLA
+  inserts the gradient ``psum`` over ICI automatically when the loss is
+  jitted with these shardings (no hand-written collectives, no NCCL
+  translation).
+* **spatial axis**: H-dimension sharding for huge single images / frames —
+  the FCN analog of sequence/context parallelism — implemented with
+  explicit halo exchange in :mod:`waternet_tpu.parallel.spatial`.
+
+Keep shardings coarse: one `NamedSharding` per argument, XLA does the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SPATIAL_AXIS = "spatial"
+
+
+def make_mesh(
+    n_data: Optional[int] = None,
+    n_spatial: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, spatial) mesh. Defaults to all devices on the data axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        assert len(devices) % n_spatial == 0, (len(devices), n_spatial)
+        n_data = len(devices) // n_spatial
+    n = n_data * n_spatial
+    grid = np.array(devices[:n]).reshape(n_data, n_spatial)
+    return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def spatial_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the H axis (axis 1 of NHWC) over the spatial axis."""
+    return NamedSharding(mesh, P(None, SPATIAL_AXIS))
+
+
+def pad_to_multiple(batch: np.ndarray, multiple: int):
+    """Pad the batch axis up to a multiple (repeat-edge); returns (arr, n_real)."""
+    n = batch.shape[0]
+    if n % multiple == 0:
+        return batch, n
+    pad = multiple - n % multiple
+    reps = np.repeat(batch[-1:], pad, axis=0)
+    return np.concatenate([batch, reps], axis=0), n

@@ -1,0 +1,99 @@
+"""Spatial sharding: exact FCN forward with the image height split across
+devices (halo exchange over ICI).
+
+WaterNet has no sequence dimension — its long-context analog is *spatial
+resolution*: the reference runs full-res 1080p video frames through the FCN
+one at a time (`/root/reference/inference.py:268-283`). For images too large
+for one chip's HBM (or to cut latency), we shard the H axis over the mesh's
+``spatial`` axis and run the whole network on overlapping slabs, exchanging
+halos like ring attention exchanges KV blocks.
+
+Exactness argument:
+
+* The network's total receptive-field radius is **13 rows**: the
+  confidence-map trunk stacks 7/5/3/1/7/5/3/3 kernels
+  (`/root/reference/waternet/net.py:12-43`) = 3+2+1+0+3+2+1+1 = 13; the
+  refiner branches need only 6, and the gated fusion is pointwise.
+* Interior slab boundaries: 13 rows of true neighbor data make every kept
+  output row identical to the unsharded forward.
+* **True image edges are subtler**: SAME convolution pads every *layer's
+  input* with zeros, so feeding an edge shard 13 zero input rows is NOT
+  equivalent (conv(0)+bias passes through ReLU and contaminates deeper
+  layers). Instead each shard computes on a *window of true data* whose
+  outer boundary coincides with the true image edge for edge shards — then
+  XLA's SAME padding at the slab edge is exactly the unsharded model's
+  behavior. Uniform SPMD shapes are kept by sliding the window (edge shards
+  extend further inward) and cropping at a shard-dependent offset.
+
+Mechanics (K = 13, slab S = H / n_shards, requires S >= 2K):
+
+* every shard sends its first/last 2K rows to its neighbors (one
+  ``lax.ppermute`` hop each way over ICI);
+* shard i assembles ``[recv_top(2K) | core(S) | recv_bot(2K)]`` and takes a
+  window of S + 2K rows starting at 2K (first shard: window = global rows
+  [0, S+2K)), K (interior: [g-K, g+S+K)), or 0 (last: [g-2K, g+S));
+* runs the full network on the window, then crops ``2K - start`` .. ``+S``.
+
+Per-device compute overlap is 26 rows — negligible for the hundreds-of-rows
+slabs this is built for; verified equal to the unsharded forward to float
+tolerance in tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from waternet_tpu.parallel.mesh import SPATIAL_AXIS
+
+# Receptive-field radius of WaterNet (see module docstring).
+HALO = 13
+
+
+def spatial_sharded_apply(module, mesh: Mesh):
+    """Build a jitted forward running H-sharded over ``mesh``'s spatial axis.
+
+    Returns ``fn(params, x, wb, ce, gc) -> out`` operating on full (global)
+    NHWC arrays; H must divide the spatial axis size and each slab must have
+    at least ``2 * HALO`` rows.
+    """
+    n_shards = mesh.shape[SPATIAL_AXIS]
+    img_spec = P(None, SPATIAL_AXIS, None, None)
+    k2 = 2 * HALO
+
+    if n_shards == 1:
+        def unsharded(params, x, wb, ce, gc):
+            return module.apply(params, x, wb, ce, gc)
+
+        return jax.jit(unsharded)
+
+    def local_fwd(params, x, wb, ce, gc):
+        slab = x.shape[1]
+        if slab < k2:
+            raise ValueError(
+                f"spatial slab of {slab} rows < 2*HALO={k2}; use fewer "
+                f"spatial shards for this image height"
+            )
+        idx = lax.axis_index(SPATIAL_AXIS)
+        down = [(i, i + 1) for i in range(n_shards - 1)]
+        up = [(i + 1, i) for i in range(n_shards - 1)]
+        start = jnp.where(idx == 0, k2, jnp.where(idx == n_shards - 1, 0, HALO))
+
+        def window(t):
+            recv_top = lax.ppermute(t[:, -k2:], SPATIAL_AXIS, down)
+            recv_bot = lax.ppermute(t[:, :k2], SPATIAL_AXIS, up)
+            c = jnp.concatenate([recv_top, t, recv_bot], axis=1)
+            return lax.dynamic_slice_in_dim(c, start, slab + k2, axis=1)
+
+        out = module.apply(params, window(x), window(wb), window(ce), window(gc))
+        return lax.dynamic_slice_in_dim(out, k2 - start, slab, axis=1)
+
+    sharded = shard_map(
+        local_fwd,
+        mesh=mesh,
+        in_specs=(P(), img_spec, img_spec, img_spec, img_spec),
+        out_specs=img_spec,
+    )
+    return jax.jit(sharded)

@@ -1,0 +1,55 @@
+"""Training losses.
+
+Spec from the reference (`/root/reference/train.py:111-127`):
+
+* pixel MSE in 0-255 scale: ``mean(square(255 * (out - ref)))``
+* perceptual: ``mean(square(255 * (vgg(norm(out)) - vgg(norm(ref)))))`` where
+  ``norm`` is ImageNet normalization and ``vgg`` is VGG19 features through
+  relu5_4
+* composite: ``0.05 * perceptual + mse`` (weight at `train.py:127`)
+
+All terms accept an optional (N,) ``mask`` so batches padded up to the data
+axis (see mesh.pad_to_multiple) contribute no loss/gradient from the padded
+duplicates. With a full mask these reduce to the reference's plain means.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from waternet_tpu.models.vgg import VGG19Features, imagenet_normalize
+from waternet_tpu.training.metrics import masked_mean
+
+PERCEPTUAL_WEIGHT = 0.05  # reference `train.py:127`
+
+
+def _per_image_mean(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape(x.shape[0], -1).mean(axis=-1)
+
+
+def mse_255(out: jnp.ndarray, ref: jnp.ndarray, mask=None) -> jnp.ndarray:
+    sq = jnp.square(255.0 * (out - ref))
+    return masked_mean(_per_image_mean(sq), mask)
+
+
+def perceptual_loss(
+    vgg: VGG19Features, vgg_params, out: jnp.ndarray, ref: jnp.ndarray, mask=None
+) -> jnp.ndarray:
+    fx = vgg.apply(vgg_params, imagenet_normalize(out))
+    fy = vgg.apply(vgg_params, imagenet_normalize(ref))
+    sq = jnp.square(255.0 * (fx - fy))
+    return masked_mean(_per_image_mean(sq), mask)
+
+
+def composite_loss(
+    vgg: VGG19Features,
+    vgg_params,
+    out: jnp.ndarray,
+    ref: jnp.ndarray,
+    perceptual_weight: float = PERCEPTUAL_WEIGHT,
+    mask=None,
+):
+    """Returns (loss, aux) with aux = dict(mse=..., perceptual_loss=...)."""
+    mse = mse_255(out, ref, mask)
+    perc = perceptual_loss(vgg, vgg_params, out, ref, mask)
+    return perceptual_weight * perc + mse, {"mse": mse, "perceptual_loss": perc}

@@ -1,0 +1,36 @@
+"""Platform selection guard.
+
+On TPU-attached hosts a sitecustomize may import jax and register an
+accelerator PJRT plugin before any user code runs; jax then initializes
+*every* registered backend on first use, dialing the accelerator even when
+the user asked for CPU (``JAX_PLATFORMS=cpu``). On a host where the tunnel
+is absent or broken that first ``jax.devices()`` blocks forever.
+
+:func:`ensure_platform` makes an explicit CPU request authoritative: when
+``JAX_PLATFORMS`` (or ``WATERNET_TPU_PLATFORM``) is ``cpu``, the non-CPU
+backend factories are deregistered before first backend init. Call it at
+CLI entry, before any jax computation. No-op otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform() -> None:
+    want = (
+        os.environ.get("WATERNET_TPU_PLATFORM")
+        or os.environ.get("JAX_PLATFORMS")
+        or ""
+    ).strip().lower()
+    if want != "cpu":
+        return
+    import jax
+    import jax._src.xla_bridge as xb
+
+    # Keep core platforms registered (their names back MLIR lowering
+    # registries); drop only experimental plugin factories like "axon".
+    for name in list(xb._backend_factories):
+        if name not in ("cpu", "tpu", "cuda", "rocm"):
+            xb._backend_factories.pop(name, None)
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,31 @@
+"""Auto-numbered run directories.
+
+Replicates the reference's savedir convention used by both training and
+inference (`/root/reference/train.py:210-221`,
+`/root/reference/inference.py:148-162`): numeric subdirs under a base output
+dir, next run gets ``max + 1``; creation is deferred so early failures don't
+leave empty dirs (`/root/reference/train.py:303-306`).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+def next_run_dir(base: Path, name: str | None = None) -> Path:
+    """Pick (but do not create) the run directory under ``base``."""
+    base = Path(base)
+    if name is not None:
+        return base / name
+    if not base.exists():
+        return base / "0"
+    nums = [
+        int(p.stem) for p in base.glob("*") if p.is_dir() and p.stem.isdecimal()
+    ]
+    return base / (str(max(nums) + 1) if nums else "0")
+
+
+def ensure_dir(path: Path) -> Path:
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    return path

@@ -1,0 +1,31 @@
+"""Array <-> network-input conversion utilities.
+
+The reference keeps three near-identical copies of `arr2ten`/`ten2arr`
+(`/root/reference/waternet/training_utils.py:11-43`,
+`/root/reference/inference.py:26-52`, `/root/reference/hubconf.py:8-34`) that
+scale uint8 [0,255] to float [0,1] and permute HWC->CHW for torch.
+
+Here there is one copy and **no permute**: TPU/XLA prefers NHWC, and the
+whole framework keeps images in NHWC end-to-end. The names are kept for
+discoverability by reference users.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def arr2ten(arr: np.ndarray) -> jnp.ndarray:
+    """uint8 (N)HWC [0,255] -> float32 NHWC [0,1]; adds batch dim if absent."""
+    ten = jnp.asarray(arr, dtype=jnp.float32) / 255.0
+    if ten.ndim == 3:
+        ten = ten[None]
+    return ten
+
+
+def ten2arr(ten: jnp.ndarray) -> np.ndarray:
+    """float NHWC [0,1] -> uint8 NHWC [0,255] (clipped), as host numpy."""
+    arr = np.asarray(ten)
+    arr = np.clip(arr, 0.0, 1.0)
+    return (arr * 255).astype(np.uint8)

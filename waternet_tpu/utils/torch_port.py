@@ -1,0 +1,109 @@
+"""One-way bridges from the reference's torch checkpoints to our param trees.
+
+Two artifact families exist upstream:
+
+* WaterNet state_dicts — the exported pretrained checkpoint
+  (``waternet_exported_state_dict-daa0ee.pt``, `/root/reference/inference.py:15`)
+  and per-run ``last.pt`` training checkpoints (`/root/reference/train.py:308`).
+  Keys: ``{cmg,wb_refiner,ce_refiner,gc_refiner}.conv{k}.{weight,bias}`` with
+  OIHW conv weights.
+* torchvision VGG19 state_dicts (for the perceptual loss,
+  `/root/reference/train.py:254-267`). Keys ``features.{idx}.{weight,bias}``.
+
+Conversion is pure tensor relayout (OIHW -> HWIO transpose); no torch model
+code is executed. ``torch.load`` is used only for deserialization and is
+imported lazily so the framework has zero torch dependency unless a torch
+checkpoint is actually being converted.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict
+
+import numpy as np
+
+# Module name in our Flax tree -> torch prefix, and conv count per module.
+_WATERNET_MODULES = {
+    "cmg": ("cmg", 8),
+    "wb_refiner": ("wb_refiner", 3),
+    "ce_refiner": ("ce_refiner", 3),
+    "gc_refiner": ("gc_refiner", 3),
+}
+
+
+def _load_torch_state_dict(path) -> Dict[str, np.ndarray]:
+    import torch
+
+    with open(path, "rb") as f:
+        sd = torch.load(f, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    return {k: v.numpy() for k, v in sd.items()}
+
+
+def _oihw_to_hwio(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(np.transpose(w, (2, 3, 1, 0)))
+
+
+def waternet_params_from_torch(path) -> dict:
+    """Convert a reference WaterNet state_dict file to our Flax param tree.
+
+    Returns a pytree shaped like ``WaterNet().init(...)`` output:
+    ``{"params": {module: {"Conv_i": {"kernel", "bias"}}}}``.
+    """
+    sd = _load_torch_state_dict(path)
+    params: dict = {}
+    for ours, (theirs, n_convs) in _WATERNET_MODULES.items():
+        mod: dict = {}
+        for i in range(n_convs):
+            w = sd[f"{theirs}.conv{i + 1}.weight"]
+            b = sd[f"{theirs}.conv{i + 1}.bias"]
+            mod[f"Conv_{i}"] = {
+                "kernel": _oihw_to_hwio(w).astype(np.float32),
+                "bias": b.astype(np.float32),
+            }
+        params[ours] = mod
+    return {"params": params}
+
+
+def vgg19_params_from_torch(path) -> dict:
+    """Convert a torchvision VGG19 state_dict (full model or features-only)
+    into the param tree used by :class:`waternet_tpu.models.vgg.VGG19Features`.
+
+    Accepts key prefixes ``features.N.*`` (torchvision vgg19) or ``model.N.*``
+    (the reference's `PerceptualModel` wrapper, `/root/reference/train.py:254-263`).
+    """
+    sd = _load_torch_state_dict(path)
+    convs = {}
+    for key, val in sd.items():
+        parts = key.split(".")
+        if len(parts) != 3 or parts[2] not in ("weight", "bias"):
+            continue
+        if parts[0] not in ("features", "model"):
+            continue
+        idx = int(parts[1])
+        convs.setdefault(idx, {})[parts[2]] = val
+    if not convs:
+        raise ValueError(f"no conv layers found in state dict at {path}")
+    params: dict = {}
+    for n, idx in enumerate(sorted(convs)):
+        layer = convs[idx]
+        params[f"Conv_{n}"] = {
+            "kernel": _oihw_to_hwio(layer["weight"]).astype(np.float32),
+            "bias": layer["bias"].astype(np.float32),
+        }
+    return {"params": params}
+
+
+def maybe_find_torch_checkpoint(search_dirs) -> Path | None:
+    """Look for a reference-style exported WaterNet .pt in the given dirs."""
+    for d in search_dirs:
+        d = Path(d)
+        if not d.is_dir():
+            continue
+        for pattern in ("waternet_exported_state_dict*.pt", "last.pt"):
+            hits = sorted(d.glob(pattern))
+            if hits:
+                return hits[0]
+    return None
