@@ -73,6 +73,13 @@ def parse_args(argv=None):
         choices=["fp32", "bf16"],
         help="(Optional) Model compute precision.",
     )
+    parser.add_argument(
+        "--spatial-shards",
+        type=int,
+        default=1,
+        help="(Optional) Split each image's height over N devices with exact "
+        "halo exchange (for frames too large for one chip).",
+    )
     return parser.parse_args(argv)
 
 
@@ -179,6 +186,7 @@ def main(argv=None):
         weights=args.weights,
         device_preprocess=args.device_preprocess,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+        spatial_shards=args.spatial_shards,
     )
 
     savedir = next_run_dir(Path(__file__).parent / "output", args.name)

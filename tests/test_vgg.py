@@ -1,0 +1,77 @@
+"""VGG19 feature-extractor parity vs an independent torch forward.
+
+Builds a torchvision-layout state_dict with random weights, converts it via
+`vgg19_params_from_torch`, and compares our NHWC Flax forward against a
+torch functional forward of the same architecture (convs + relu + maxpool,
+final maxpool dropped — the reference's `features[:-1]` cut,
+`/root/reference/train.py:260`).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from waternet_tpu.models.vgg import VGG19Features, imagenet_normalize  # noqa: E402
+from waternet_tpu.utils.torch_port import vgg19_params_from_torch  # noqa: E402
+
+# torchvision vgg19 `features` conv indices and channel widths.
+_CONV_IDXS = [0, 2, 5, 7, 10, 12, 14, 16, 19, 21, 23, 25, 28, 30, 32, 34]
+_POOL_IDXS = {4, 9, 18, 27, 36}
+_WIDTHS = [64, 64, 128, 128, 256, 256, 256, 256,
+           512, 512, 512, 512, 512, 512, 512, 512]
+
+
+def _random_vgg_state_dict(seed=0):
+    g = torch.Generator().manual_seed(seed)
+    sd = {}
+    cin = 3
+    for idx, cout in zip(_CONV_IDXS, _WIDTHS):
+        sd[f"features.{idx}.weight"] = torch.randn((cout, cin, 3, 3), generator=g) * 0.03
+        sd[f"features.{idx}.bias"] = torch.randn((cout,), generator=g) * 0.03
+        cin = cout
+    return sd
+
+
+def _torch_vgg_forward(sd, x):
+    import torch.nn.functional as F
+
+    out = x
+    for idx in range(36):  # features[:-1]: stop before index 36 (last pool)
+        if idx in _CONV_IDXS:
+            out = F.relu(
+                F.conv2d(out, sd[f"features.{idx}.weight"],
+                         sd[f"features.{idx}.bias"], padding=1)
+            )
+        elif idx in _POOL_IDXS:
+            out = F.max_pool2d(out, 2, 2)
+    return out
+
+
+def test_vgg19_forward_parity(tmp_path):
+    sd = _random_vgg_state_dict()
+    pt = tmp_path / "vgg.pt"
+    torch.save(sd, pt)
+    params = vgg19_params_from_torch(pt)
+
+    rng = np.random.default_rng(0)
+    x = rng.random((1, 32, 32, 3)).astype(np.float32)
+
+    want = _torch_vgg_forward(
+        sd, torch.from_numpy(x.transpose(0, 3, 1, 2))
+    ).numpy().transpose(0, 2, 3, 1)
+
+    import jax.numpy as jnp
+
+    got = np.asarray(VGG19Features().apply(params, jnp.asarray(x)))
+    assert got.shape == want.shape == (1, 2, 2, 512)  # H/16 x W/16 x 512
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+def test_imagenet_normalize_values():
+    import jax.numpy as jnp
+
+    x = jnp.full((1, 2, 2, 3), 0.5)
+    out = np.asarray(imagenet_normalize(x))
+    want = (0.5 - np.array([0.485, 0.456, 0.406])) / np.array([0.229, 0.224, 0.225])
+    np.testing.assert_allclose(out[0, 0, 0], want.astype(np.float32), atol=1e-6)

@@ -51,7 +51,8 @@ def parse_args(argv=None):
     p.add_argument("--no-augment", action="store_true", help="Disable flips/rot90 augmentation")
     p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from")
     p.add_argument("--synthetic", type=int, default=0, metavar="N", help="Train on N synthetic pairs instead of reading a dataset")
-    p.add_argument("--profile-dir", type=str, help="Capture a jax.profiler trace of epoch 1 into this dir")
+    p.add_argument("--profile-dir", type=str, help="Capture a jax.profiler trace of the first post-compilation epoch (epoch 2, or epoch 1 when --epochs 1) into this dir")
+    p.add_argument("--debug-nans", action="store_true", help="Enable jax NaN checking (slower; for debugging diverging runs)")
     return p.parse_args(argv)
 
 
@@ -64,6 +65,9 @@ def main(argv=None):
 
     ensure_platform()
     import jax
+
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
 
     from waternet_tpu.data.uieb import UIEBDataset, reference_split
     from waternet_tpu.data.synthetic import SyntheticPairs
@@ -124,9 +128,11 @@ def main(argv=None):
     savedir = next_run_dir(projectroot / "training")
     saved_train = {k: [] for k in TRAIN_METRICS_NAMES}
     saved_val = {k: [] for k in VAL_METRICS_NAMES}
+    throughputs = []
 
+    profile_epoch = min(1, args.epochs - 1)  # first post-compilation epoch
     for epoch in range(args.epochs):
-        if args.profile_dir and epoch == 1:
+        if args.profile_dir and epoch == profile_epoch:
             jax.profiler.start_trace(args.profile_dir)
         t0 = time.perf_counter()
         train_metrics = engine.train_epoch(
@@ -144,10 +150,11 @@ def main(argv=None):
             dataset.batches(val_idx, config.batch_size, shuffle=False)
         )
         dt = time.perf_counter() - t0
-        if args.profile_dir and epoch == 1:
+        if args.profile_dir and epoch == profile_epoch:
             jax.profiler.stop_trace()
 
         ips = len(train_idx) / train_dt
+        throughputs.append(ips)
         print(
             f"Epoch {epoch + 1}/{args.epochs} "
             f"[train {train_dt:.1f}s + val {dt - train_dt:.1f}s, {ips:.1f} img/s]"
@@ -181,6 +188,18 @@ def main(argv=None):
         savedir / "metrics-val.csv", val_arr, fmt="%f", delimiter=",",
         comments="", header=",".join(VAL_METRICS_NAMES),
     )
+    # Run summary: the BASELINE.json headline metric alongside the run.
+    with open(savedir / "summary.json", "w") as f:
+        json.dump(
+            {
+                "train_images_per_sec_mean": float(np.mean(throughputs)),
+                "train_images_per_sec_last": float(throughputs[-1]),
+                "epochs": len(throughputs),
+                "wall_time_sec": time.perf_counter() - start_ts,
+            },
+            f,
+            indent=4,
+        )
     with open(savedir / "config.json", "w") as f:
         json.dump(
             {

@@ -34,7 +34,13 @@ class InferenceEngine:
         params: Optional[dict] = None,
         device_preprocess: bool = False,
         dtype=jnp.float32,
+        spatial_shards: int = 1,
     ):
+        """``spatial_shards > 1`` splits each image's height over that many
+        devices with exact halo-exchange (see waternet_tpu.parallel.spatial)
+        — for frames too large for one chip's HBM. Requires
+        ``spatial_shards`` devices and H divisible by it with slabs >= 26
+        rows."""
         from waternet_tpu.utils.platform import ensure_platform
 
         ensure_platform()
@@ -50,8 +56,16 @@ class InferenceEngine:
         self.params = params
         self.device_preprocess = device_preprocess
 
-        def _forward(p, rgb, wb, ce, gc):
-            return self.module.apply(p, rgb, wb, ce, gc)
+        self.spatial_shards = spatial_shards
+        if spatial_shards > 1:
+            from waternet_tpu.parallel.mesh import make_mesh
+            from waternet_tpu.parallel.spatial import spatial_sharded_apply
+
+            mesh = make_mesh(n_data=1, n_spatial=spatial_shards)
+            # Already jitted; do not wrap in another jax.jit layer.
+            _forward = spatial_sharded_apply(self.module, mesh)
+        else:
+            _forward = jax.jit(self.module.apply)
 
         def _fused(p, rgb_u8):
             """uint8 batch -> enhanced float batch, preprocessing on device."""
@@ -59,11 +73,29 @@ class InferenceEngine:
             rgb = rgb_u8.astype(jnp.float32) / 255.0
             return _forward(p, rgb, wb / 255.0, he / 255.0, gc / 255.0)
 
-        self._forward = jax.jit(_forward)
+        self._forward = _forward
         self._fused = jax.jit(_fused)
+
+    def _validate_shape(self, rgb_batch) -> None:
+        if self.spatial_shards <= 1:
+            return
+        from waternet_tpu.parallel.spatial import HALO
+
+        h = rgb_batch.shape[1]
+        if h % self.spatial_shards != 0:
+            raise ValueError(
+                f"image height {h} not divisible by spatial_shards="
+                f"{self.spatial_shards}"
+            )
+        if h // self.spatial_shards < 2 * HALO:
+            raise ValueError(
+                f"spatial slab of {h // self.spatial_shards} rows < "
+                f"2*HALO={2 * HALO}; use fewer spatial shards for this height"
+            )
 
     def enhance(self, rgb_batch: np.ndarray) -> np.ndarray:
         """(N, H, W, 3) uint8 RGB -> (N, H, W, 3) uint8 RGB enhanced."""
+        self._validate_shape(rgb_batch)
         if self.device_preprocess:
             out = self._fused(self.params, jnp.asarray(rgb_batch))
         else:
@@ -90,6 +122,7 @@ class InferenceEngine:
         while the host continues (used for video double-buffering). Call
         :func:`waternet_tpu.utils.tensor.ten2arr` on the result to sync.
         """
+        self._validate_shape(rgb_batch)
         if self.device_preprocess:
             return self._fused(self.params, jnp.asarray(rgb_batch))
         wb, gc, he = zip(*(transform_np(f) for f in rgb_batch))

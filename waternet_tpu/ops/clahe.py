@@ -69,6 +69,7 @@ def clahe(
     l_chan: jnp.ndarray,
     clip_limit: float = CLIP_LIMIT,
     tile_grid: tuple[int, int] = TILE_GRID,
+    use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """OpenCV-exact CLAHE on one channel.
 
@@ -89,11 +90,22 @@ def clahe(
     n_tiles = ty * tx
     tile_area = th * tw
 
-    # --- per-tile histograms via bincount (scatter-add under jit) ---
+    # --- per-tile histograms ---
     tiles = x.reshape(ty, th, tx, tw).transpose(0, 2, 1, 3).reshape(n_tiles, tile_area)
-    tile_ids = jnp.repeat(jnp.arange(n_tiles, dtype=jnp.int32), tile_area)
-    flat_idx = tile_ids * 256 + tiles.reshape(-1)
-    hist = jnp.bincount(flat_idx, length=n_tiles * 256).reshape(n_tiles, 256)
+    if use_pallas is None:
+        from waternet_tpu.ops.pallas_kernels import pallas_enabled
+
+        use_pallas = pallas_enabled()
+    if use_pallas:
+        # Dense VPU comparison-reduction kernel (scatter-free).
+        from waternet_tpu.ops.pallas_kernels import tile_histogram
+
+        hist = tile_histogram(tiles)
+    else:
+        # XLA path: bincount lowers to scatter-add.
+        tile_ids = jnp.repeat(jnp.arange(n_tiles, dtype=jnp.int32), tile_area)
+        flat_idx = tile_ids * 256 + tiles.reshape(-1)
+        hist = jnp.bincount(flat_idx, length=n_tiles * 256).reshape(n_tiles, 256)
 
     # --- clip + redistribute (OpenCV integer semantics) ---
     clip = max(int(clip_limit * tile_area / 256.0), 1)

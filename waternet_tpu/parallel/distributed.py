@@ -1,0 +1,92 @@
+"""Multi-host (multi-process) initialization and batch distribution.
+
+The reference has no distributed backend at all (no torch.distributed /
+NCCL / MPI anywhere — single `.to(device)` placement,
+`/root/reference/train.py:247`). The TPU-native story needs no hand-rolled
+backend either: on a multi-host pod slice,
+
+1. every host calls :func:`initialize` (a thin, idempotent wrapper over
+   ``jax.distributed.initialize`` — on TPU pods coordinator discovery is
+   automatic from the TPU environment);
+2. ``jax.devices()`` then returns the *global* device list, so the same
+   ``make_mesh()`` + NamedSharding code that runs single-host runs
+   pod-scale: XLA routes the gradient all-reduce over ICI within a slice
+   and DCN across slices, chosen by the mesh axis ordering;
+3. each host feeds only its local shard of the batch
+   (:func:`local_batch_slice`), and `jax.make_array_from_process_local_data`
+   assembles the global sharded array.
+
+Single-host (including CI) is the degenerate case: process_count == 1 and
+everything below is a no-op passthrough.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Idempotent `jax.distributed.initialize` (no-op when single-process
+    or already initialized). On TPU pods all arguments are discovered from
+    the environment; set them explicitly only for CPU/GPU multi-process.
+
+    Must be called before any other jax API (anything that initializes the
+    XLA backend makes `jax.distributed.initialize` impossible — so this
+    deliberately avoids `jax.devices()` / `jax.process_count()` itself and
+    checks the distributed client state directly).
+    """
+    from jax._src import distributed as _dist
+
+    if getattr(_dist.global_state, "client", None) is not None:
+        return  # already initialized
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            # TPU pod auto-discovery; fails benignly on plain single hosts.
+            jax.distributed.initialize()
+    except (RuntimeError, ValueError) as e:
+        if explicit:
+            raise  # user asked for multi-process; failing silently would
+            # let every host train an independent duplicate run
+        import sys
+
+        print(
+            f"[waternet_tpu] single-process mode ({type(e).__name__}: {e})",
+            file=sys.stderr,
+        )
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """The half-open index range of the global batch this host should load.
+
+    Dataset indices are globally shuffled with the same seed on every host
+    (deterministic Philox in `waternet_tpu.data.batching`), so slicing the
+    order per host partitions the epoch without communication.
+    """
+    n, i = jax.process_count(), jax.process_index()
+    per = global_batch // n
+    rem = global_batch % n
+    start = i * per + min(i, rem)
+    return slice(start, start + per + (1 if i < rem else 0))
+
+
+def global_sharded_batch(local_arr: np.ndarray, mesh, spec):
+    """Assemble a globally-sharded jax.Array from this host's local shard."""
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), local_arr
+    )

@@ -37,9 +37,17 @@ def make_mesh(
     """Build a (data, spatial) mesh. Defaults to all devices on the data axis."""
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
-        assert len(devices) % n_spatial == 0, (len(devices), n_spatial)
+        if len(devices) % n_spatial != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by n_spatial={n_spatial}"
+            )
         n_data = len(devices) // n_spatial
     n = n_data * n_spatial
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh ({n_data} data x {n_spatial} spatial) needs {n} devices, "
+            f"but only {len(devices)} are available"
+        )
     grid = np.array(devices[:n]).reshape(n_data, n_spatial)
     return Mesh(grid, (DATA_AXIS, SPATIAL_AXIS))
 
