@@ -44,12 +44,16 @@ def parse_args(argv=None):
     p.add_argument("--data-root", type=str, default="data", help="Dataset root containing raw-890/ and reference-890/")
     p.add_argument("--val-size", type=int, default=90, help="Validation split size (default 90)")
     p.add_argument("--precision", type=str, default="bf16", choices=["bf16", "fp32"])
+    p.add_argument("--spatial-shards", type=int, default=1,
+                   help="Shard image height over N mesh devices during training "
+                   "(for resolutions whose activations exceed one chip)")
     p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual loss")
     p.add_argument("--no-perceptual", action="store_true", help="Disable the VGG perceptual term")
     p.add_argument("--host-preprocess", action="store_true", help="cv2/NumPy WB+GC+CLAHE on host (bit-exact, slow)")
     p.add_argument("--no-shuffle", action="store_true", help="Reference bug-compat: no train shuffling")
     p.add_argument("--no-augment", action="store_true", help="Disable flips/rot90 augmentation")
-    p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from")
+    p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from, or 'auto' to pick up the latest run's state")
+    p.add_argument("--tensorboard", action="store_true", help="Write TensorBoard scalars to <rundir>/tb")
     p.add_argument("--synthetic", type=int, default=0, metavar="N", help="Train on N synthetic pairs instead of reading a dataset")
     p.add_argument("--profile-dir", type=str, help="Capture a jax.profiler trace of the first post-compilation epoch (epoch 2, or epoch 1 when --epochs 1) into this dir")
     p.add_argument("--debug-nans", action="store_true", help="Enable jax NaN checking (slower; for debugging diverging runs)")
@@ -94,6 +98,7 @@ def main(argv=None):
         augment=not args.no_augment,
         perceptual_weight=0.0 if args.no_perceptual else 0.05,
         host_preprocess=args.host_preprocess,
+        spatial_shards=args.spatial_shards,
     )
 
     # --- data ---
@@ -122,13 +127,29 @@ def main(argv=None):
         params = resolve_weights(args.weights)
     vgg_params = None if args.no_perceptual else resolve_vgg_params(args.vgg_weights)
     engine = TrainingEngine(config, params=params, vgg_params=vgg_params)
-    if args.resume:
+    if args.resume == "auto":
+        from waternet_tpu.utils.rundir import latest_run_dir
+
+        latest = latest_run_dir(projectroot / "training")
+        if latest is not None and (latest / "state").is_dir():
+            print(f"Auto-resuming from {latest / 'state'}")
+            engine.restore(latest / "state")
+        else:
+            print("No previous run state found; starting fresh")
+    elif args.resume:
         engine.restore(args.resume)
 
     savedir = next_run_dir(projectroot / "training")
     saved_train = {k: [] for k in TRAIN_METRICS_NAMES}
     saved_val = {k: [] for k in VAL_METRICS_NAMES}
     throughputs = []
+    tb_writer = None
+    if args.tensorboard:
+        import tensorflow as tf
+
+        # (The writer creates its directory itself; this is the one feature
+        # that materializes the run dir before the first epoch completes.)
+        tb_writer = tf.summary.create_file_writer(str(savedir / "tb"))
 
     profile_epoch = min(1, args.epochs - 1)  # first post-compilation epoch
     for epoch in range(args.epochs):
@@ -172,6 +193,17 @@ def main(argv=None):
             saved_train[k].append(v)
         for k, v in val_metrics.items():
             saved_val[k].append(v)
+
+        if tb_writer is not None:
+            import tensorflow as tf
+
+            with tb_writer.as_default(step=epoch):
+                for k, v in train_metrics.items():
+                    tf.summary.scalar(f"train/{k}", v)
+                for k, v in val_metrics.items():
+                    tf.summary.scalar(f"val/{k}", v)
+                tf.summary.scalar("perf/images_per_sec", ips)
+            tb_writer.flush()  # don't lose the epoch on abnormal exit
 
         # Savedir created as late as possible (reference `train.py:303-306`).
         savedir.mkdir(parents=True, exist_ok=True)

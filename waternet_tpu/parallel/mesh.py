@@ -57,6 +57,16 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def image_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """NHWC batches: batch over the data axis AND H over the spatial axis.
+
+    With a spatial axis of size 1 this degenerates to plain batch sharding;
+    with more, XLA's SPMD partitioner materializes the spatial split (conv
+    halo exchanges, collective quantiles/pools) from the annotation alone.
+    """
+    return NamedSharding(mesh, P(DATA_AXIS, SPATIAL_AXIS))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -35,7 +35,11 @@ from waternet_tpu.data.augment import augment_pair_batch
 from waternet_tpu.models import WaterNet
 from waternet_tpu.models.vgg import VGG19Features
 from waternet_tpu.ops import transform_batch
-from waternet_tpu.parallel.mesh import batch_sharding, make_mesh, replicated
+from waternet_tpu.parallel.mesh import (
+    image_batch_sharding,
+    make_mesh,
+    replicated,
+)
 from waternet_tpu.training.losses import PERCEPTUAL_WEIGHT, composite_loss
 from waternet_tpu.training.metrics import psnr as psnr_fn
 from waternet_tpu.training.metrics import ssim as ssim_fn
@@ -61,6 +65,13 @@ class TrainConfig:
     # Host preprocessing (cv2/NumPy WB+GC+CLAHE per item, reference-bit-exact
     # but serialized on host CPU). Default off: device preprocessing.
     host_preprocess: bool = False
+    # Spatial (H-axis) sharding of the training images over the mesh's
+    # spatial axis, for very-high-resolution training where one chip can't
+    # hold the activations. Implemented by sharding annotations alone —
+    # XLA's SPMD partitioner inserts the conv halo exchanges; cross-H ops
+    # (WB quantiles, CLAHE interpolation, VGG pools) get collectives
+    # automatically. 1 = off (pure data parallelism).
+    spatial_shards: int = 1
 
     @property
     def dtype(self):
@@ -97,7 +108,9 @@ class TrainingEngine:
         self.config = config
         self.model = WaterNet(dtype=config.dtype)
         self.vgg = VGG19Features(dtype=config.dtype)
-        self.mesh = mesh if mesh is not None else make_mesh()
+        if mesh is None:
+            mesh = make_mesh(n_spatial=config.spatial_shards)
+        self.mesh = mesh
         self.optimizer = make_optimizer(config)
 
         if params is None:
@@ -160,7 +173,7 @@ class TrainingEngine:
 
     def _compile_steps(self):
         mesh = self.mesh
-        bsh = batch_sharding(mesh)
+        bsh = image_batch_sharding(mesh)
         rep = replicated(mesh)
 
         def _mask(n_total, n_real):
@@ -240,8 +253,18 @@ class TrainingEngine:
         """
         import numpy as np
 
-        from waternet_tpu.parallel.mesh import DATA_AXIS, pad_to_multiple
+        from waternet_tpu.parallel.mesh import (
+            DATA_AXIS,
+            SPATIAL_AXIS,
+            pad_to_multiple,
+        )
 
+        n_spatial = self.mesh.shape[SPATIAL_AXIS]
+        if n_spatial > 1 and np.asarray(raw).shape[1] % n_spatial != 0:
+            raise ValueError(
+                f"image height {np.asarray(raw).shape[1]} not divisible by "
+                f"spatial_shards={n_spatial}"
+            )
         n_data = self.mesh.shape[DATA_AXIS]
         raw_p, n_real = pad_to_multiple(np.asarray(raw), n_data)
         ref_p, _ = pad_to_multiple(np.asarray(ref), n_data)

@@ -25,6 +25,17 @@ def next_run_dir(base: Path, name: str | None = None) -> Path:
     return base / (str(max(nums) + 1) if nums else "0")
 
 
+def latest_run_dir(base: Path) -> Path | None:
+    """The highest-numbered existing run dir under ``base``, or None."""
+    base = Path(base)
+    if not base.exists():
+        return None
+    nums = [
+        int(p.stem) for p in base.glob("*") if p.is_dir() and p.stem.isdecimal()
+    ]
+    return base / str(max(nums)) if nums else None
+
+
 def ensure_dir(path: Path) -> Path:
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
