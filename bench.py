@@ -29,10 +29,68 @@ WARMUP_STEPS = int(os.environ.get("WATERNET_BENCH_WARMUP", 3))
 MEASURE_STEPS = int(os.environ.get("WATERNET_BENCH_STEPS", 30))
 
 
+def bench_video(hw=(1080, 1920), batch=4, steps=12):
+    """Secondary benchmark: full-res video-frame enhancement throughput
+    (BASELINE config 5), double-buffered like the video CLI path."""
+    import jax
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.models import WaterNet
+    from waternet_tpu.utils.tensor import ten2arr
+
+    import jax.numpy as jnp
+
+    h, w = hw
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    params = WaterNet(dtype=jnp.bfloat16).init(jax.random.PRNGKey(0), x, x, x, x)
+    engine = InferenceEngine(
+        params=params, device_preprocess=True, dtype=jnp.bfloat16
+    )
+    frames = np.stack(
+        [SyntheticPairs(1, h, w, seed=i).load_pair(0)[0] for i in range(batch)]
+    )
+    ten2arr(engine.enhance_async(frames))  # warmup/compile
+
+    t0 = time.perf_counter()
+    pending = engine.enhance_async(frames)
+    for _ in range(steps - 1):
+        nxt = engine.enhance_async(frames)
+        ten2arr(pending)
+        pending = nxt
+    ten2arr(pending)
+    dt = time.perf_counter() - t0
+    fps = batch * steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"video_{h}p_frames_per_sec_per_chip",
+                "value": round(fps, 2),
+                "unit": "frames/sec/chip",
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
 def main():
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--config", choices=["train", "video"], default="train",
+        help="train (default; the one-line contract metric) or video "
+        "(full-res frame throughput, BASELINE config 5)",
+    )
+    args = parser.parse_args()
+    if args.config == "video":
+        hw = (HW, HW * 16 // 9) if "WATERNET_BENCH_HW" in os.environ else (1080, 1920)
+        return bench_video(hw=hw, steps=MEASURE_STEPS)
+
     from waternet_tpu.data.synthetic import SyntheticPairs
     from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
 

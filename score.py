@@ -45,7 +45,71 @@ def parse_args(argv=None):
     p.add_argument("--bug-compat-perceptual", action="store_true",
                    help="Reproduce the reference's perceptual_loss accumulation bug")
     p.add_argument("--json-out", type=str, help="Also write metrics to this JSON file")
+    p.add_argument(
+        "--raw-dir", type=str,
+        help="Score a directory of raw images with NO references (e.g. UIEB "
+        "challenging-60) using no-reference metrics (UCIQE/UIQM), before and "
+        "after enhancement. Paired metrics are skipped in this mode.",
+    )
     return p.parse_args(argv)
+
+
+def score_no_reference(args):
+    """Challenging-60-style scoring: no ground truth exists, so report
+    UCIQE/UIQM on the raw inputs and on the enhanced outputs."""
+    from pathlib import Path
+
+    import cv2
+    import jax.numpy as jnp
+    import numpy as np
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.training.metrics_nr import uciqe_batch, uiqm_batch
+
+    files = sorted(
+        p for p in Path(args.raw_dir).glob("*")
+        if p.suffix.lower() in (".png", ".jpg", ".jpeg", ".bmp")
+    )
+    if not files:
+        raise FileNotFoundError(f"no images found in {args.raw_dir}")
+    engine = InferenceEngine(
+        weights=args.weights,
+        device_preprocess=args.device_preprocess,
+        dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+    )
+    import sys
+
+    sums = {"uciqe_raw": 0.0, "uiqm_raw": 0.0, "uciqe_enhanced": 0.0, "uiqm_enhanced": 0.0}
+    n_scored = 0
+    for start in range(0, len(files), args.batch_size):
+        chunk = files[start : start + args.batch_size]
+        raws = []
+        for f in chunk:
+            bgr = cv2.imread(str(f))
+            if bgr is None:
+                print(f"Skipping unreadable image: {f}", file=sys.stderr)
+                continue
+            bgr = cv2.resize(bgr, (args.width, args.height))
+            raws.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
+        if not raws:
+            continue
+        n_real = len(raws)
+        # Pad the final partial chunk so jit compiles one batch shape only.
+        while len(raws) < args.batch_size:
+            raws.append(raws[-1])
+        raw = np.stack(raws)
+        out = engine.enhance(raw)
+        for key, batch in (
+            ("uciqe_raw", uciqe_batch(jnp.asarray(raw))),
+            ("uiqm_raw", uiqm_batch(jnp.asarray(raw))),
+            ("uciqe_enhanced", uciqe_batch(jnp.asarray(out))),
+            ("uiqm_enhanced", uiqm_batch(jnp.asarray(out))),
+        ):
+            sums[key] += float(np.asarray(batch)[:n_real].sum())
+        n_scored += n_real
+    if n_scored == 0:
+        raise FileNotFoundError(f"no readable images in {args.raw_dir}")
+    return {k: v / n_scored for k, v in sums.items()} | {"images": n_scored}
 
 
 def main(argv=None):
@@ -55,6 +119,16 @@ def main(argv=None):
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+
+    if args.raw_dir:
+        metrics = score_no_reference(args)
+        pprint(metrics)
+        print(f"Scored {metrics['images']} raw images in {time.perf_counter() - t0:.1f}s")
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(metrics, f, indent=2)
+        return
+
     from waternet_tpu.data.uieb import UIEBDataset, reference_split
     from waternet_tpu.hub import resolve_weights
     from waternet_tpu.models.vgg import resolve_vgg_params

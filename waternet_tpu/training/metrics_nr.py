@@ -1,0 +1,131 @@
+"""No-reference underwater image quality metrics: UCIQE and UIQM.
+
+The UIEB benchmark's *Challenging-60* split has no ground-truth reference
+images, so paired MSE/SSIM/PSNR cannot score it (the reference
+implementation simply cannot evaluate that split — `score.py` only handles
+the paired 890). These are the two standard no-reference metrics from the
+underwater-enhancement literature:
+
+* **UCIQE** (Yang & Sowmya, 2015): a linear combination of chroma std,
+  luminance contrast, and saturation mean in CIELAB/HSV space —
+  ``0.4680 * sigma_c + 0.2745 * con_l + 0.2576 * mu_s``.
+* **UIQM** (Panetta et al., 2016): colorfulness (UICM, asymmetric
+  alpha-trimmed opponent-channel statistics) + sharpness (UISM, Sobel-EME
+  over blocks) + contrast (UIConM, AMEE over blocks):
+  ``0.0282 * UICM + 0.2953 * UISM + 3.5753 * UIConM``.
+
+Both are pure JAX (jittable, vmappable). Implementations follow the common
+open-source formulations; absolute values match the literature's ballpark
+and are primarily meaningful for *comparisons* (raw vs enhanced).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from waternet_tpu.ops.color import rgb_to_lab_u8
+
+
+def _block_reduce(x: jnp.ndarray, block: int, fn) -> jnp.ndarray:
+    """Apply fn over non-overlapping (block, block) windows. Crops remainder."""
+    h, w = x.shape
+    bh, bw = h // block, w // block
+    v = x[: bh * block, : bw * block].reshape(bh, block, bw, block)
+    return fn(fn(v, 3), 1)  # reduce inner axes
+
+
+def uciqe(rgb: jnp.ndarray) -> jnp.ndarray:
+    """(H, W, 3) uint8-valued RGB -> scalar UCIQE."""
+    lab = rgb_to_lab_u8(rgb)
+    lum = lab[..., 0] / 255.0
+    a = lab[..., 1] - 128.0
+    b = lab[..., 2] - 128.0
+    chroma = jnp.sqrt(a * a + b * b) / 255.0
+    sigma_c = jnp.std(chroma)
+    con_l = jnp.quantile(lum, 0.99) - jnp.quantile(lum, 0.01)
+
+    x = rgb.astype(jnp.float32) / 255.0
+    mx = x.max(axis=-1)
+    mn = x.min(axis=-1)
+    sat = jnp.where(mx > 0, (mx - mn) / jnp.maximum(mx, 1e-6), 0.0)
+    mu_s = jnp.mean(sat)
+    return 0.4680 * sigma_c + 0.2745 * con_l + 0.2576 * mu_s
+
+
+def _alpha_trimmed_stats(v: jnp.ndarray, alpha_l=0.1, alpha_r=0.1):
+    s = jnp.sort(v.reshape(-1))
+    n = s.shape[0]
+    lo = int(n * alpha_l)
+    hi = n - int(n * alpha_r)
+    t = s[lo:hi]
+    mu = jnp.mean(t)
+    var = jnp.mean(jnp.square(t - mu))
+    return mu, var
+
+
+def _uicm(rgb: jnp.ndarray) -> jnp.ndarray:
+    x = rgb.astype(jnp.float32)
+    rg = x[..., 0] - x[..., 1]
+    yb = 0.5 * (x[..., 0] + x[..., 1]) - x[..., 2]
+    mu_rg, var_rg = _alpha_trimmed_stats(rg)
+    mu_yb, var_yb = _alpha_trimmed_stats(yb)
+    return -0.0268 * jnp.sqrt(mu_rg**2 + mu_yb**2) + 0.1586 * jnp.sqrt(
+        var_rg + var_yb
+    )
+
+
+def _sobel_mag(chan: jnp.ndarray) -> jnp.ndarray:
+    kx = jnp.array([[1, 0, -1], [2, 0, -2], [1, 0, -1]], jnp.float32)
+    ky = kx.T
+    pad = jnp.pad(chan, 1, mode="edge")
+    from jax import lax
+
+    def conv(k):
+        return lax.conv_general_dilated(
+            pad[None, :, :, None],
+            k[:, :, None, None],
+            (1, 1),
+            "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[0, :, :, 0]
+
+    return jnp.sqrt(conv(kx) ** 2 + conv(ky) ** 2)
+
+
+def _eme(chan: jnp.ndarray, block: int = 8) -> jnp.ndarray:
+    mx = _block_reduce(chan, block, jnp.max)
+    mn = _block_reduce(chan, block, jnp.min)
+    ratio = jnp.maximum(mx, 1.0) / jnp.maximum(mn, 1.0)
+    return jnp.mean(2.0 * jnp.log(ratio))
+
+
+def _uism(rgb: jnp.ndarray) -> jnp.ndarray:
+    x = rgb.astype(jnp.float32)
+    weights = (0.299, 0.587, 0.114)
+    total = 0.0
+    for c, w in enumerate(weights):
+        edge = _sobel_mag(x[..., c]) * x[..., c]
+        total = total + w * _eme(edge)
+    return total
+
+
+def _uiconm(rgb: jnp.ndarray, block: int = 8) -> jnp.ndarray:
+    inten = jnp.mean(rgb.astype(jnp.float32), axis=-1)
+    mx = _block_reduce(inten, block, jnp.max)
+    mn = _block_reduce(inten, block, jnp.min)
+    num = mx - mn
+    den = jnp.maximum(mx + mn, 1e-6)
+    r = jnp.where(num > 0, num / den, 0.0)
+    return jnp.mean(jnp.where(r > 0, r * jnp.log(jnp.maximum(r, 1e-6)), 0.0)) * -1.0
+
+
+def uiqm(rgb: jnp.ndarray) -> jnp.ndarray:
+    """(H, W, 3) uint8-valued RGB -> scalar UIQM."""
+    return (
+        0.0282 * _uicm(rgb) + 0.2953 * _uism(rgb) + 3.5753 * _uiconm(rgb)
+    )
+
+
+uciqe_batch = jax.vmap(uciqe)
+uiqm_batch = jax.vmap(uiqm)
