@@ -93,11 +93,10 @@ def score_no_reference(args):
             raws.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
         if not raws:
             continue
-        n_real = len(raws)
         # Pad the final partial chunk so jit compiles one batch shape only.
-        while len(raws) < args.batch_size:
-            raws.append(raws[-1])
-        raw = np.stack(raws)
+        from waternet_tpu.parallel.mesh import pad_to_multiple
+
+        raw, n_real = pad_to_multiple(np.stack(raws), args.batch_size)
         out = engine.enhance(raw)
         for key, batch in (
             ("uciqe_raw", uciqe_batch(jnp.asarray(raw))),

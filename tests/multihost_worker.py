@@ -1,0 +1,52 @@
+"""Worker process for the multi-host training test (see test_multihost.py).
+
+Run as: python tests/multihost_worker.py <process_id> <num_processes> <port>
+Prints the epoch loss; both ranks must agree (the batch is globally sharded
+and gradients all-reduce across processes).
+"""
+
+import os
+import sys
+from pathlib import Path
+
+proc_id = int(sys.argv[1])
+num_procs = int(sys.argv[2])
+port = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from waternet_tpu.utils.platform import ensure_platform  # noqa: E402
+
+ensure_platform()
+import jax  # noqa: E402
+
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from waternet_tpu.parallel.distributed import initialize  # noqa: E402
+
+initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=num_procs,
+    process_id=proc_id,
+)
+
+import numpy as np  # noqa: E402
+
+from waternet_tpu.training.trainer import TrainConfig, TrainingEngine  # noqa: E402
+
+cfg = TrainConfig(
+    batch_size=4, im_height=32, im_width=32,
+    precision="fp32", perceptual_weight=0.0, augment=False,
+)
+engine = TrainingEngine(cfg)
+rng = np.random.default_rng(0)
+raw = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+ref = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+metrics = engine.train_epoch([(raw, ref)], epoch=0)
+print(
+    f"RESULT proc={proc_id} procs={jax.process_count()} "
+    f"devices={jax.device_count()} loss={metrics['loss']:.6f}",
+    flush=True,
+)

@@ -1,0 +1,45 @@
+"""True multi-process "multi-host" training test over gloo CPU collectives.
+
+Spawns two OS processes, each with 2 forced CPU devices, forming a 4-device
+global mesh; the training batch is globally sharded and the gradient
+all-reduce crosses the process boundary. Both ranks must report the same
+loss.
+
+~2-3 min of per-process compilation, so gated behind WATERNET_TEST_MULTIHOST=1
+(the capability is also exercised continuously in single-process form via
+`TrainingEngine._to_global`'s passthrough path).
+"""
+
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("WATERNET_TEST_MULTIHOST") != "1",
+    reason="set WATERNET_TEST_MULTIHOST=1 to run the 2-process training test",
+)
+
+
+def test_two_process_training_agrees():
+    worker = Path(__file__).parent / "multihost_worker.py"
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", "7655"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    results = {}
+    for out in outs:
+        m = re.search(r"RESULT proc=(\d) procs=(\d) devices=(\d) loss=([\d.]+)", out)
+        assert m, f"worker output missing RESULT line:\n{out[-2000:]}"
+        assert m.group(2) == "2" and m.group(3) == "4", out[-500:]
+        results[m.group(1)] = float(m.group(4))
+    assert len(results) == 2
+    assert results["0"] == results["1"], results

@@ -1,0 +1,50 @@
+"""Convert reference torch checkpoints to native hash-verified .npz weights.
+
+Usage:
+    # WaterNet checkpoints (the exported daa0ee state_dict or a last.pt)
+    python tools/convert_weights.py --waternet waternet_exported_state_dict-daa0ee.pt --out weights/
+
+    # torchvision VGG19 weights for the perceptual loss
+    python tools/convert_weights.py --vgg vgg19-dcbb9e9d.pth --out weights/
+
+Conversion is pure tensor relayout (OIHW -> HWIO); torch is only used for
+deserialization. The hub API and CLIs accept the torch files directly too —
+this tool just produces the torch-free artifact for deployment images.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--waternet", type=str, help="Reference WaterNet state_dict (.pt)")
+    p.add_argument("--vgg", type=str, help="torchvision VGG19 state_dict (.pt/.pth)")
+    p.add_argument("--out", type=str, default="weights", help="Output directory")
+    args = p.parse_args()
+    if not args.waternet and not args.vgg:
+        p.error("provide --waternet and/or --vgg")
+
+    from waternet_tpu.utils.checkpoint import export_weights
+    from waternet_tpu.utils.torch_port import (
+        vgg19_params_from_torch,
+        waternet_params_from_torch,
+    )
+
+    if args.waternet:
+        params = waternet_params_from_torch(args.waternet)
+        path = export_weights(params, args.out, stem="waternet_tpu")
+        print(f"WaterNet weights -> {path}")
+    if args.vgg:
+        params = vgg19_params_from_torch(args.vgg)
+        path = export_weights(params, args.out, stem="vgg19_tpu")
+        print(f"VGG19 weights -> {path}")
+
+
+if __name__ == "__main__":
+    main()

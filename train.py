@@ -65,10 +65,20 @@ def main(argv=None):
     start_ts = time.perf_counter()
     projectroot = Path(__file__).parent
 
+    # Multi-host bootstrap MUST precede any backend-touching jax call; a
+    # no-op on single hosts (see waternet_tpu/parallel/distributed.py).
+    from waternet_tpu.parallel.distributed import initialize
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    initialize()
     import jax
+
+    if jax.process_count() > 1:
+        print(
+            f"Multi-host: process {jax.process_index()}/{jax.process_count()}, "
+            f"{jax.local_device_count()} local of {jax.device_count()} devices"
+        )
 
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
@@ -125,6 +135,8 @@ def main(argv=None):
         from waternet_tpu.hub import resolve_weights
 
         params = resolve_weights(args.weights)
+        if params is None:
+            raise FileNotFoundError(f"could not load weights from {args.weights}")
     vgg_params = None if args.no_perceptual else resolve_vgg_params(args.vgg_weights)
     engine = TrainingEngine(config, params=params, vgg_params=vgg_params)
     if args.resume == "auto":
@@ -206,10 +218,14 @@ def main(argv=None):
             tb_writer.flush()  # don't lose the epoch on abnormal exit
 
         # Savedir created as late as possible (reference `train.py:303-306`).
-        savedir.mkdir(parents=True, exist_ok=True)
-        save_weights(engine.state.params, savedir / "last.npz")
-        engine.checkpoint(savedir / "state")
+        # Multi-host: process 0 is the single artifact writer.
+        if jax.process_index() == 0:
+            savedir.mkdir(parents=True, exist_ok=True)
+            save_weights(engine.state.params, savedir / "last.npz")
+            engine.checkpoint(savedir / "state")
 
+    if jax.process_index() != 0:
+        return
     train_arr = np.stack([np.asarray(saved_train[k]) for k in TRAIN_METRICS_NAMES], 1)
     val_arr = np.stack([np.asarray(saved_val[k]) for k in VAL_METRICS_NAMES], 1)
     np.savetxt(

@@ -63,7 +63,9 @@ def augment_pair_np(rng, raw, ref):
         if rng.random() < 0.5:
             k = int(rng.integers(0, 4))
             if not square:
-                k = 2 if k in (1, 2, 3) else 0
+                # Match the device path's non-square degradation exactly:
+                # only k==2 (180 deg) is shape-preserving; 90/270 are dropped.
+                k = 2 if k == 2 else 0
             raw[i] = np.rot90(raw[i], k, axes=(0, 1))
             ref[i] = np.rot90(ref[i], k, axes=(0, 1))
     return raw, ref

@@ -61,10 +61,18 @@ class JittedModel:
 
 
 def resolve_weights(weights=None, search_dirs=(".", "weights")) -> dict | None:
-    """Find and load WaterNet weights. Returns a param pytree or None."""
+    """Find and load WaterNet weights. Returns a param pytree or None.
+
+    An explicitly named path that does not exist raises immediately —
+    silently falling through to whatever checkpoint happens to be lying in
+    ./weights would train/infer with the wrong weights.
+    """
     candidates = []
     if weights is not None:
-        candidates.append(Path(weights))
+        explicit = Path(weights)
+        if not explicit.exists():
+            raise FileNotFoundError(f"weights path does not exist: {weights}")
+        candidates.append(explicit)
     env = os.environ.get("WATERNET_TPU_WEIGHTS")
     if env:
         candidates.append(Path(env))

@@ -95,25 +95,7 @@ class InferenceEngine:
 
     def enhance(self, rgb_batch: np.ndarray) -> np.ndarray:
         """(N, H, W, 3) uint8 RGB -> (N, H, W, 3) uint8 RGB enhanced."""
-        self._validate_shape(rgb_batch)
-        if self.device_preprocess:
-            out = self._fused(self.params, jnp.asarray(rgb_batch))
-        else:
-            wbs, gcs, hes = [], [], []
-            for frame in rgb_batch:
-                wb, gc, he = transform_np(frame)
-                wbs.append(wb)
-                gcs.append(gc)
-                hes.append(he)
-            to_dev = lambda arrs: jnp.asarray(np.stack(arrs), jnp.float32) / 255.0
-            out = self._forward(
-                self.params,
-                to_dev(list(rgb_batch)),
-                to_dev(wbs),
-                to_dev(hes),
-                to_dev(gcs),
-            )
-        return ten2arr(out)
+        return ten2arr(self.enhance_async(rgb_batch))
 
     def enhance_async(self, rgb_batch: np.ndarray):
         """Launch enhancement without blocking; returns a device array future.

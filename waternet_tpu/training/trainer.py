@@ -118,13 +118,17 @@ class TrainingEngine:
             params = self.model.init(
                 jax.random.PRNGKey(config.seed), zeros, zeros, zeros, zeros
             )
-        if vgg_params is None:
+        if vgg_params is None and config.perceptual_weight != 0.0:
             from waternet_tpu.models.vgg import init_vgg_params
 
             vgg_params = init_vgg_params(dtype=config.dtype)
 
         rep = replicated(self.mesh)
-        self.vgg_params = jax.device_put(vgg_params, rep)
+        # ~80 MB of replicated VGG HBM; skipped entirely when the
+        # perceptual term is off (the step never applies it).
+        self.vgg_params = (
+            jax.device_put(vgg_params, rep) if vgg_params is not None else None
+        )
         self.state = TrainStateT(
             params=jax.device_put(params, rep),
             opt_state=jax.device_put(self.optimizer.init(params), rep),
@@ -245,6 +249,27 @@ class TrainingEngine:
             eval_step_pre, in_shardings=(rep,) + pre_b + (rep,), out_shardings=rep
         )
 
+    def _to_global(self, arr):
+        """Host numpy batch -> (possibly multi-host) global sharded array.
+
+        Single-process: plain device transfer. Multi-process: every host
+        holds the identical full global batch (the dataset iterator is
+        deterministic in (seed, epoch) on all hosts), and each host's
+        devices pick their shards via the callback — no cross-host data
+        movement beyond the eventual collectives inside the step.
+        """
+        import numpy as np
+
+        if jax.process_count() == 1:
+            return jnp.asarray(arr)
+        from waternet_tpu.parallel.mesh import image_batch_sharding
+
+        arr = np.asarray(arr)
+        sharding = image_batch_sharding(self.mesh)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
     def _pad_batch(self, raw, ref):
         """Pad the batch to a data-axis multiple; returns (raw, ref, n_real).
 
@@ -280,7 +305,9 @@ class TrainingEngine:
         if rng_np is not None and self.config.augment:
             raw, ref = augment_pair_np(rng_np, raw, ref)
         wbs, gcs, hes = zip(*(transform_np(f) for f in raw))
-        as_f = lambda arrs: jnp.asarray(np.stack(list(arrs)), jnp.float32) / 255.0
+        as_f = lambda arrs: self._to_global(
+            np.stack(list(arrs)).astype(np.float32) / 255.0
+        )
         return as_f(raw), as_f(wbs), as_f(hes), as_f(gcs), as_f(ref)
 
     # ------------------------------------------------------------------
@@ -309,7 +336,8 @@ class TrainingEngine:
                     jax.random.fold_in(base_rng, epoch), count
                 )
                 self.state, metrics = self.train_step(
-                    self.state, jnp.asarray(raw), jnp.asarray(ref), rng, n_real
+                    self.state, self._to_global(raw), self._to_global(ref),
+                    rng, n_real,
                 )
             pending.append(metrics)
             count += 1
@@ -330,7 +358,8 @@ class TrainingEngine:
             else:
                 pending.append(
                     self.eval_step(
-                        self.state, jnp.asarray(raw), jnp.asarray(ref), n_real
+                        self.state, self._to_global(raw), self._to_global(ref),
+                        n_real,
                     )
                 )
             count += 1
