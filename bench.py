@@ -73,10 +73,39 @@ def bench_video(hw=(1080, 1920), batch=4, steps=12):
     )
 
 
+def _probe_accelerator(timeout_s: int = 180):
+    """Check device init in a subprocess so a dead accelerator tunnel can't
+    hang the benchmark forever (the PJRT client retries in a sleep loop with
+    no error). Returns None on success, else an error string."""
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent
+    script = (
+        f"import sys; sys.path.insert(0, {str(repo)!r}); "
+        "from waternet_tpu.utils.platform import ensure_platform; "
+        "ensure_platform(); import jax; jax.devices()"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script], timeout=timeout_s, capture_output=True
+        )
+    except subprocess.TimeoutExpired:
+        return f"accelerator unreachable (device init exceeded {timeout_s}s)"
+    if proc.returncode != 0:
+        tail = proc.stderr.decode(errors="replace").strip().splitlines()[-3:]
+        return "device probe failed: " + " | ".join(tail)
+    return None
+
+
 def main():
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    from waternet_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
 
     import argparse
 
@@ -87,6 +116,21 @@ def main():
         "(full-res frame throughput, BASELINE config 5)",
     )
     args = parser.parse_args()
+
+    probe_error = _probe_accelerator()
+    if probe_error is not None:
+        print(
+            json.dumps(
+                {
+                    "metric": "uieb_train_images_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "images/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": probe_error,
+                }
+            )
+        )
+        raise SystemExit(1)
     if args.config == "video":
         hw = (HW, HW * 16 // 9) if "WATERNET_BENCH_HW" in os.environ else (1080, 1920)
         return bench_video(hw=hw, steps=MEASURE_STEPS)

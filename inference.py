@@ -164,6 +164,9 @@ def main(argv=None):
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    from waternet_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
     import jax.numpy as jnp
 
     from waternet_tpu.inference_engine import InferenceEngine

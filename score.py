@@ -118,6 +118,9 @@ def main(argv=None):
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    from waternet_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
 
     if args.raw_dir:
         metrics = score_no_reference(args)

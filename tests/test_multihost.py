@@ -24,17 +24,31 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def test_two_process_training_agrees():
     worker = Path(__file__).parent / "multihost_worker.py"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    port = str(_free_port())
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), "2", "7655"],
+            [sys.executable, str(worker), str(i), "2", port],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=600)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+    finally:
+        for p in procs:  # never leak workers / the coordinator port
+            if p.poll() is None:
+                p.kill()
     results = {}
     for out in outs:
         m = re.search(r"RESULT proc=(\d) procs=(\d) devices=(\d) loss=([\d.]+)", out)

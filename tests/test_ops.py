@@ -166,3 +166,25 @@ def test_device_outputs_are_uint8_valued(sample_rgb):
         a = np.asarray(arr)
         assert a.min() >= 0 and a.max() <= 255
         np.testing.assert_array_equal(a, np.round(a))
+
+
+@pytest.mark.parametrize(
+    "frame",
+    [
+        np.zeros((24, 24, 3), np.uint8),  # all black (zero channel sums)
+        np.full((24, 24, 3), 77, np.uint8),  # constant channels
+        np.dstack(
+            [np.zeros((24, 24), np.uint8), np.full((24, 24), 10, np.uint8),
+             np.full((24, 24), 200, np.uint8)]
+        ),  # one black channel
+    ],
+    ids=["black", "constant", "one-black-channel"],
+)
+def test_degenerate_frames_no_nan(frame):
+    """Fade-to-black / constant video frames must not emit NaN (device) or
+    crash (host). The reference crashes on these (`data.py:38-48`)."""
+    wb_host = white_balance_np(frame)
+    assert np.isfinite(wb_host.astype(np.float64)).all()
+    for arr in transform(frame):
+        a = np.asarray(arr)
+        assert np.isfinite(a).all(), "NaN/inf leaked from device transform"

@@ -90,6 +90,19 @@ def test_spatial_single_shard_degenerate(model_and_params):
     )
 
 
+def test_spatial_minimum_slab_boundary(model_and_params):
+    """Slab exactly == 2*HALO (26 rows) is the smallest legal shard size."""
+    model, params = model_and_params
+    mesh = make_mesh(n_data=4, n_spatial=2)
+    x = jnp.asarray(np.random.default_rng(3).random((1, 52, 40, 3)), jnp.float32)
+    fn = spatial_sharded_apply(model, mesh)
+    np.testing.assert_allclose(
+        np.asarray(fn(params, x, x, x, x)),
+        np.asarray(model.apply(params, x, x, x, x)),
+        atol=2e-5,
+    )
+
+
 def test_pad_to_multiple():
     arr = np.arange(5 * 2).reshape(5, 2)
     padded, n = pad_to_multiple(arr, 4)

@@ -71,6 +71,9 @@ def main(argv=None):
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()
+    from waternet_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache()
     initialize()
     import jax
 
@@ -156,11 +159,12 @@ def main(argv=None):
     saved_val = {k: [] for k in VAL_METRICS_NAMES}
     throughputs = []
     tb_writer = None
-    if args.tensorboard:
+    if args.tensorboard and jax.process_index() == 0:
         import tensorflow as tf
 
         # (The writer creates its directory itself; this is the one feature
-        # that materializes the run dir before the first epoch completes.)
+        # that materializes the run dir before the first epoch completes.
+        # Process 0 only: N identical event files would jitter the curves.)
         tb_writer = tf.summary.create_file_writer(str(savedir / "tb"))
 
     profile_epoch = min(1, args.epochs - 1)  # first post-compilation epoch
@@ -218,11 +222,14 @@ def main(argv=None):
             tb_writer.flush()  # don't lose the epoch on abnormal exit
 
         # Savedir created as late as possible (reference `train.py:303-306`).
-        # Multi-host: process 0 is the single artifact writer.
+        # Multi-host: process 0 writes the npz; the Orbax checkpoint is a
+        # process-COLLECTIVE (it synchronizes all hosts internally) and must
+        # be called by every process or the others hang in the next
+        # all-reduce while 0 waits at the Orbax barrier.
+        savedir.mkdir(parents=True, exist_ok=True)
         if jax.process_index() == 0:
-            savedir.mkdir(parents=True, exist_ok=True)
             save_weights(engine.state.params, savedir / "last.npz")
-            engine.checkpoint(savedir / "state")
+        engine.checkpoint(savedir / "state")
 
     if jax.process_index() != 0:
         return

@@ -67,15 +67,29 @@ def resolve_weights(weights=None, search_dirs=(".", "weights")) -> dict | None:
     silently falling through to whatever checkpoint happens to be lying in
     ./weights would train/infer with the wrong weights.
     """
-    candidates = []
+    def _load_strict(path: Path, origin: str) -> dict:
+        if not path.exists():
+            raise FileNotFoundError(f"{origin} path does not exist: {path}")
+        if path.suffix == ".npz":
+            return load_weights(path)
+        if path.suffix in (".pt", ".pth"):
+            from waternet_tpu.utils.torch_port import waternet_params_from_torch
+
+            return waternet_params_from_torch(path)
+        raise ValueError(
+            f"{origin} path has unsupported suffix {path.suffix!r} "
+            f"(expected .npz or .pt/.pth): {path}"
+        )
+
+    # Explicitly named paths (argument or env var) are strict: any problem
+    # raises rather than silently falling back to checkpoints in ./weights.
     if weights is not None:
-        explicit = Path(weights)
-        if not explicit.exists():
-            raise FileNotFoundError(f"weights path does not exist: {weights}")
-        candidates.append(explicit)
+        return _load_strict(Path(weights), "weights")
     env = os.environ.get("WATERNET_TPU_WEIGHTS")
     if env:
-        candidates.append(Path(env))
+        return _load_strict(Path(env), "WATERNET_TPU_WEIGHTS")
+
+    candidates = []
     for d in search_dirs:
         d = Path(d)
         if d.is_dir():

@@ -17,6 +17,32 @@ from __future__ import annotations
 import os
 
 
+def enable_compile_cache() -> None:
+    """Enable jax's persistent compilation cache (default: ~/.cache/...).
+
+    TPU compiles of the fused train step take 20-40s; the cache makes every
+    later CLI invocation with the same shapes start instantly. Honors an
+    existing ``JAX_COMPILATION_CACHE_DIR``; disable with
+    ``WATERNET_TPU_NO_CACHE=1``.
+    """
+    if os.environ.get("WATERNET_TPU_NO_CACHE") == "1":
+        return
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # user already configured it via env
+    import pathlib
+
+    import jax
+
+    cache_dir = pathlib.Path.home() / ".cache" / "waternet_tpu" / "xla"
+    try:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        # Cache everything, including sub-second compiles.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # cache is an optimization; never fail startup over it
+
+
 def ensure_platform() -> None:
     want = (
         os.environ.get("WATERNET_TPU_PLATFORM")
