@@ -109,6 +109,24 @@ def test_clahe_core_bitexact_nondivisible(rng):
     np.testing.assert_array_equal(got, want.astype(np.float32))
 
 
+def test_clahe_core_bitexact_fuzz_shapes(rng):
+    """The bit-exactness claim must hold across arbitrary shapes (odd tile
+    sizes exercise the float32-reciprocal coordinate ties; narrow images
+    exercise clamping; large tiles exercise the redistribute arithmetic)."""
+    import cv2
+
+    from waternet_tpu.ops.clahe import clahe
+
+    cl = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8))
+    for h, w in [(8, 8), (17, 31), (56, 56), (100, 36), (64, 200), (131, 97)]:
+        lum = rng.integers(0, 256, size=(h, w), dtype=np.uint8)
+        want = cl.apply(lum)
+        got = np.asarray(clahe(lum.astype(np.float32)))
+        np.testing.assert_array_equal(
+            got, want.astype(np.float32), err_msg=f"shape {(h, w)}"
+        )
+
+
 def test_lab_conversion_close_to_cv2(sample_rgb):
     import cv2
 
