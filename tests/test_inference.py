@@ -94,6 +94,37 @@ def test_video_stream_order_and_count(engine, tmp_path):
         assert abs(int(bgr_in[40, 60, 0]) - (i * 20 % 255)) <= 10
 
 
+def test_cli_video_roundtrip(random_params, tmp_path, monkeypatch):
+    cv2 = pytest.importorskip("cv2")
+
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    import inference as cli
+
+    weights = tmp_path / "w.npz"
+    save_weights(random_params, weights)
+    src = tmp_path / "in.mp4"
+    w = cv2.VideoWriter(str(src), cv2.VideoWriter.fourcc(*"mp4v"), 5, (64, 48))
+    for i in range(6):
+        w.write(np.full((48, 64, 3), 30 + i * 10, np.uint8))
+    w.release()
+
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir",
+        lambda base, name=None: tmp_path / "out",
+    )
+    cli.main(
+        ["--source", str(src), "--weights", str(weights),
+         "--batch-size", "3", "--show-split"]
+    )
+    out = tmp_path / "out" / "in.mp4"
+    assert out.exists()
+    cap = cv2.VideoCapture(str(out))
+    assert int(cap.get(cv2.CAP_PROP_FRAME_COUNT)) == 6
+    assert int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)) == 64
+    cap.release()
+
+
 def test_cli_image_roundtrip(random_params, tmp_path, monkeypatch, sample_rgb):
     cv2 = pytest.importorskip("cv2")
 
