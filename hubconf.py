@@ -1,0 +1,35 @@
+"""torch.hub entry point — API-compatible with the reference's hubconf
+(`/root/reference/hubconf.py:37-96`), backed by the JAX implementation.
+
+    preprocess, postprocess, model = torch.hub.load(
+        "<this-repo>", "waternet", source="github")   # or source="local"
+
+Returns the same ``(preprocess, postprocess, model)`` triple with the same
+``(rgb, wb, he, gc)`` ordering; arrays are NHWC jax arrays rather than NCHW
+torch tensors (postprocess still yields NHWC uint8 numpy). torch.hub is only
+the loader here — the dependency list is jax, not torch.
+"""
+
+dependencies = ["jax", "flax", "numpy", "cv2"]
+
+
+def waternet(pretrained: bool = True, weights=None, device=None):
+    """Build WaterNet. ``device`` is accepted for signature compatibility
+    with the reference and ignored (jax manages placement)."""
+    import sys
+    from pathlib import Path
+
+    # torch.hub puts this dir on sys.path only for the entry-point call;
+    # make the package importable without permanently shadowing user modules
+    # (the repo root holds generically named CLIs like inference.py).
+    repo = str(Path(__file__).resolve().parent)
+    added = repo not in sys.path
+    if added:
+        sys.path.insert(0, repo)
+    try:
+        from waternet_tpu.hub import waternet as _waternet
+    finally:
+        if added and repo in sys.path:
+            sys.path.remove(repo)
+
+    return _waternet(pretrained=pretrained, weights=weights)

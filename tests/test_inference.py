@@ -59,6 +59,29 @@ def test_hub_triple_contract(random_params, sample_rgb, tmp_path, monkeypatch):
     assert arr.dtype == np.uint8 and arr.shape == (1,) + sample_rgb.shape
 
 
+def test_torch_hub_load_local(random_params, tmp_path):
+    """The repo works as a literal torch.hub source (reference README usage:
+    `torch.hub.load('tnwei/waternet', 'waternet')`)."""
+    torch = pytest.importorskip("torch")
+
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    import inference as _inf
+
+    from pathlib import Path
+
+    repo = Path(_inf.__file__).parent
+    weights = tmp_path / "w.npz"
+    save_weights(random_params, weights)
+
+    pre, post, model = torch.hub.load(
+        str(repo), "waternet", source="local", weights=str(weights)
+    )
+    rgb = np.random.default_rng(0).integers(0, 256, (24, 24, 3), dtype=np.uint8)
+    out = model(*pre(rgb))
+    assert post(out).shape == (1, 24, 24, 3)
+
+
 def test_hub_missing_weights_raises(monkeypatch, tmp_path):
     from waternet_tpu.hub import waternet
 
