@@ -1,0 +1,53 @@
+"""Dependency hygiene: the core package must stay importable without the
+optional heavyweights.
+
+torch is only a converter/loader dependency, cv2 only a host-path and CLI
+dependency, tensorflow only behind --tensorboard — all imported lazily
+inside functions. A module-level import sneaking in would break egress-less
+TPU images that ship none of them (and, for jnp allocations, initialize the
+backend at import — see waternet_tpu/utils/platform.py docstring).
+"""
+
+import ast
+from pathlib import Path
+
+import pytest
+
+PKG = Path(__file__).resolve().parent.parent / "waternet_tpu"
+FORBIDDEN_TOP_LEVEL = {"torch", "torchvision", "cv2", "tensorflow", "albumentations"}
+
+
+def _module_level_imports(path: Path):
+    """Imports that execute at module import time — walks into top-level
+    try/if/with compounds (the `try: import torch` pattern still runs at
+    import), but not into function or class bodies (those are lazy)."""
+    tree = ast.parse(path.read_text())
+
+    def walk(body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    yield alias.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                yield node.module.split(".")[0]
+            else:
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(node, field, None)
+                    if sub:
+                        if field == "handlers":
+                            for h in sub:
+                                yield from walk(h.body)
+                        else:
+                            yield from walk(sub)
+
+    yield from walk(tree.body)
+
+
+@pytest.mark.parametrize(
+    "path", sorted(PKG.rglob("*.py")), ids=lambda p: str(p.relative_to(PKG))
+)
+def test_no_heavy_module_level_imports(path):
+    bad = FORBIDDEN_TOP_LEVEL & set(_module_level_imports(path))
+    assert not bad, f"{path} imports {bad} at module level"
