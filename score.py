@@ -16,6 +16,15 @@ Notes carried over from the survey of the reference:
   number exactly.
 * host (cv2) preprocessing is the default for parity-grade numbers; use
   ``--device-preprocess`` for speed.
+* **deliberate deviation**: the reference's val dataloader inherits
+  UIEBDataset's default *random* flip/rot90 augmentation during eval
+  (default ``A.Compose`` at `/root/reference/waternet/training_utils.py:72-78`,
+  applied at `:109-111`, inherited by `score.py:135-143`'s val loader), so
+  its reported numbers are stochastic under a
+  fixed checkpoint. This scorer evaluates unaugmented — deterministic and
+  the standard practice — so values will differ slightly from a reference
+  run even on identical weights; expect agreement in distribution, not
+  run-for-run.
 """
 
 from __future__ import annotations
@@ -39,6 +48,10 @@ def parse_args(argv=None):
     p.add_argument("--val-size", type=int, default=90)
     p.add_argument("--split", type=str, default="val", choices=["val", "train", "all"],
                    help="Which part of the seed-0 split to score (reference: val)")
+    p.add_argument("--allow-nonreference-split", action="store_true",
+                   help="Proceed even when the reference torch seed-0 split "
+                        "cannot be reproduced (non-890 dataset without torch); "
+                        "scores are then NOT comparable to the reference")
     p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual metric")
     p.add_argument("--precision", type=str, default="fp32", choices=["bf16", "fp32"])
     p.add_argument("--device-preprocess", action="store_true")
@@ -143,7 +156,26 @@ def main(argv=None):
         im_height=args.height,
         im_width=args.width,
     )
-    train_idx, val_idx = reference_split(len(dataset), n_val=args.val_size)
+    # Scoring on a non-reference split silently produces wrong-but-plausible
+    # numbers (train/val leakage for reference-trained checkpoints), so a
+    # fallback-split warning here is a hard error unless explicitly allowed.
+    import warnings
+
+    from waternet_tpu.data.uieb import NonReferenceSplitWarning
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always", RuntimeWarning)
+        train_idx, val_idx = reference_split(len(dataset), n_val=args.val_size)
+    if any(issubclass(w.category, NonReferenceSplitWarning) for w in caught):
+        if not args.allow_nonreference_split:
+            raise SystemExit(
+                "score.py: refusing to score on a non-reference split "
+                "(torch unavailable and dataset is not the canonical 890 "
+                "pairs). Re-run with --allow-nonreference-split to proceed "
+                "anyway; the numbers will not be comparable to the reference."
+            )
+        for w in caught:
+            warnings.warn_explicit(w.message, w.category, w.filename, w.lineno)
     indices = {"val": val_idx, "train": train_idx,
                "all": np.arange(len(dataset))}[args.split]
 

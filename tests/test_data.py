@@ -19,6 +19,8 @@ def test_reference_split_deterministic():
 
 
 def test_reference_split_matches_torch_stream():
+    # reference_split(890) now reads a static constant; this pins that
+    # constant against the live torch seed-0 stream it was generated from.
     torch = pytest.importorskip("torch")
     g = torch.Generator()
     g.manual_seed(0)
@@ -26,6 +28,37 @@ def test_reference_split_matches_torch_stream():
     t, v = reference_split(890)
     np.testing.assert_array_equal(t, perm[:800])
     np.testing.assert_array_equal(v, perm[800:])
+
+
+def test_reference_split_non890_matches_torch_stream():
+    torch = pytest.importorskip("torch")
+    g = torch.Generator()
+    g.manual_seed(0)
+    perm = torch.randperm(100, generator=g).numpy()
+    t, v = reference_split(100, n_val=10)
+    np.testing.assert_array_equal(t, perm[:90])
+    np.testing.assert_array_equal(v, perm[90:])
+
+
+def test_reference_split_canonical_needs_no_torch(monkeypatch):
+    import sys
+    import warnings
+
+    monkeypatch.setitem(sys.modules, "torch", None)  # import torch -> ImportError
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning fails the test
+        t, v = reference_split(890)
+    assert len(t) == 800 and len(v) == 90
+
+
+def test_reference_split_fallback_warns_loudly(monkeypatch):
+    import sys
+
+    from waternet_tpu.data.uieb import NonReferenceSplitWarning
+
+    monkeypatch.setitem(sys.modules, "torch", None)
+    with pytest.warns(NonReferenceSplitWarning, match="does NOT match the reference"):
+        reference_split(100, n_val=10)
 
 
 def test_synthetic_pairs_deterministic_and_shaped():

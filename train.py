@@ -233,6 +233,7 @@ def main(argv=None):
 
     if jax.process_index() != 0:
         return
+    savedir.mkdir(parents=True, exist_ok=True)  # --epochs 0: loop never ran
     train_arr = np.stack([np.asarray(saved_train[k]) for k in TRAIN_METRICS_NAMES], 1)
     val_arr = np.stack([np.asarray(saved_val[k]) for k in VAL_METRICS_NAMES], 1)
     np.savetxt(
@@ -244,17 +245,13 @@ def main(argv=None):
         comments="", header=",".join(VAL_METRICS_NAMES),
     )
     # Run summary: the BASELINE.json headline metric alongside the run.
+    # Guarded for --epochs 0 (checkpoint-save-only runs have no throughputs).
+    summary = {"epochs": len(throughputs), "wall_time_sec": time.perf_counter() - start_ts}
+    if throughputs:
+        summary["train_images_per_sec_mean"] = float(np.mean(throughputs))
+        summary["train_images_per_sec_last"] = float(throughputs[-1])
     with open(savedir / "summary.json", "w") as f:
-        json.dump(
-            {
-                "train_images_per_sec_mean": float(np.mean(throughputs)),
-                "train_images_per_sec_last": float(throughputs[-1]),
-                "epochs": len(throughputs),
-                "wall_time_sec": time.perf_counter() - start_ts,
-            },
-            f,
-            indent=4,
-        )
+        json.dump(summary, f, indent=4)
     with open(savedir / "config.json", "w") as f:
         json.dump(
             {

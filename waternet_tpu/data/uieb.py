@@ -42,6 +42,15 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 
+class NonReferenceSplitWarning(RuntimeWarning):
+    """The computed split does NOT match the reference's torch seed-0 split.
+
+    Emitted by :func:`reference_split` when torch is unavailable for a
+    non-canonical (n_total, seed); callers that need reference-comparable
+    numbers (``score.py``) treat it as a hard error.
+    """
+
+
 def reference_split(
     n_total: int, n_val: int = 90, seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -49,19 +58,39 @@ def reference_split(
 
     ``torch.utils.data.random_split(ds, [800, 90])`` under
     ``torch.manual_seed(0)`` permutes indices with the global torch RNG
-    (`/root/reference/train.py:160,233`); we reproduce that stream via torch
-    when available so reference-trained checkpoints score on the identical
-    90 images. Fallback: numpy Philox permutation (documented, not
-    torch-identical).
+    (`/root/reference/train.py:160,233`).  For the canonical 890-pair UIEB
+    at seed 0 the resulting permutation ships as a static constant
+    (:data:`waternet_tpu.data._split_constants.TORCH_SEED0_PERM_890`), so
+    the reference split never depends on torch being importable.  Other
+    (n_total, seed) combinations reproduce the torch stream when torch is
+    available; otherwise a numpy Philox permutation is used and a **loud
+    warning** is emitted, because that split is *not* the reference's —
+    scoring a reference-trained checkpoint on it would leak training
+    images into val.
     """
-    try:
-        import torch
+    if n_total == 890 and seed == 0:
+        from waternet_tpu.data._split_constants import TORCH_SEED0_PERM_890
 
-        g = torch.Generator()
-        g.manual_seed(seed)
-        perm = torch.randperm(n_total, generator=g).numpy()
-    except ImportError:  # pragma: no cover - torch is present in CI image
-        perm = np.random.Generator(np.random.Philox(seed)).permutation(n_total)
+        perm = np.asarray(TORCH_SEED0_PERM_890, dtype=np.int64)
+    else:
+        try:
+            import torch
+
+            g = torch.Generator()
+            g.manual_seed(seed)
+            perm = torch.randperm(n_total, generator=g).numpy()
+        except ImportError:
+            import warnings
+
+            warnings.warn(
+                "torch unavailable: reference_split is falling back to a "
+                "numpy permutation that does NOT match the reference's "
+                "torch seed-0 split. Scores computed on this split are not "
+                "comparable to the reference (train/val leakage).",
+                NonReferenceSplitWarning,
+                stacklevel=2,
+            )
+            perm = np.random.Generator(np.random.Philox(seed)).permutation(n_total)
     n_train = n_total - n_val
     return perm[:n_train], perm[n_train:]
 

@@ -42,10 +42,17 @@ def initialize(
     deliberately avoids `jax.devices()` / `jax.process_count()` itself and
     checks the distributed client state directly).
     """
-    from jax._src import distributed as _dist
+    # ``jax._src.distributed.global_state`` is a private internal used only
+    # for the idempotence check; if a jax upgrade moves it, fall through to
+    # ``jax.distributed.initialize`` and let its own "already initialized"
+    # RuntimeError be handled below.
+    try:
+        from jax._src import distributed as _dist
 
-    if getattr(_dist.global_state, "client", None) is not None:
-        return  # already initialized
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already initialized
+    except (ImportError, AttributeError):  # pragma: no cover
+        pass
     explicit = coordinator_address is not None or num_processes is not None
     try:
         if explicit:
@@ -58,6 +65,9 @@ def initialize(
             # TPU pod auto-discovery; fails benignly on plain single hosts.
             jax.distributed.initialize()
     except (RuntimeError, ValueError) as e:
+        if "already initialized" in str(e).lower():
+            return  # idempotence backstop when the private-state check above
+            # was unavailable
         if explicit:
             raise  # user asked for multi-process; failing silently would
             # let every host train an independent duplicate run

@@ -52,11 +52,24 @@ def ensure_platform() -> None:
     if want != "cpu":
         return
     import jax
-    import jax._src.xla_bridge as xb
 
     # Keep core platforms registered (their names back MLIR lowering
     # registries); drop only experimental plugin factories like "axon".
-    for name in list(xb._backend_factories):
-        if name not in ("cpu", "tpu", "cuda", "rocm"):
-            xb._backend_factories.pop(name, None)
+    # ``_backend_factories`` is a private jax internal; if a jax upgrade
+    # moves it, degrade to the documented config knob alone rather than
+    # failing every CLI at startup.
+    try:
+        import jax._src.xla_bridge as xb
+
+        for name in list(xb._backend_factories):
+            if name not in ("cpu", "tpu", "cuda", "rocm"):
+                xb._backend_factories.pop(name, None)
+    except (ImportError, AttributeError) as e:  # pragma: no cover
+        import sys
+
+        print(
+            f"[waternet_tpu] could not deregister plugin backends "
+            f"({type(e).__name__}: {e}); relying on jax_platforms=cpu only",
+            file=sys.stderr,
+        )
     jax.config.update("jax_platforms", "cpu")
