@@ -174,8 +174,8 @@ def main(argv=None):
                 "pairs). Re-run with --allow-nonreference-split to proceed "
                 "anyway; the numbers will not be comparable to the reference."
             )
-        for w in caught:
-            warnings.warn_explicit(w.message, w.category, w.filename, w.lineno)
+    for w in caught:  # replay everything recorded, fatal or not
+        warnings.warn_explicit(w.message, w.category, w.filename, w.lineno)
     indices = {"val": val_idx, "train": train_idx,
                "all": np.arange(len(dataset))}[args.split]
 

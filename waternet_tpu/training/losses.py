@@ -41,15 +41,7 @@ def perceptual_loss(
     return masked_mean(_per_image_mean(sq), mask)
 
 
-def composite_loss(
-    vgg: VGG19Features,
-    vgg_params,
-    out: jnp.ndarray,
-    ref: jnp.ndarray,
-    perceptual_weight: float = PERCEPTUAL_WEIGHT,
-    mask=None,
-):
-    """Returns (loss, aux) with aux = dict(mse=..., perceptual_loss=...)."""
-    mse = mse_255(out, ref, mask)
-    perc = perceptual_loss(vgg, vgg_params, out, ref, mask)
-    return perceptual_weight * perc + mse, {"mse": mse, "perceptual_loss": perc}
+# The composite ``perceptual_weight * perc + mse`` lives in
+# TrainingEngine._losses_and_out, which reshards the VGG operands
+# independently of the pixel-loss operands; keeping a second copy of the
+# formula here invited divergence, so there isn't one.

@@ -36,11 +36,17 @@ from waternet_tpu.models import WaterNet
 from waternet_tpu.models.vgg import VGG19Features
 from waternet_tpu.ops import transform_batch
 from waternet_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SPATIAL_AXIS,
     image_batch_sharding,
     make_mesh,
     replicated,
 )
-from waternet_tpu.training.losses import PERCEPTUAL_WEIGHT, composite_loss
+from waternet_tpu.training.losses import (
+    PERCEPTUAL_WEIGHT,
+    mse_255,
+    perceptual_loss,
+)
 from waternet_tpu.training.metrics import psnr as psnr_fn
 from waternet_tpu.training.metrics import ssim as ssim_fn
 
@@ -149,20 +155,46 @@ class TrainingEngine:
         wb, gc, he = transform_batch(raw)
         return raw / 255.0, wb / 255.0, he / 255.0, gc / 255.0, ref / 255.0
 
+    def _unshard_spatial(self, t):
+        """Reshard an NHWC batch to batch-only sharding (H gathered).
+
+        VGG's deep stages shrink the feature map to a few rows; an H-sharded
+        3x3 conv there puts the per-shard extent *below* the halo width, a
+        regime where XLA's SPMD partitioner miscompiles (observed: exactly
+        2x-scaled features for a SAME conv on H=2 split into 1-row shards —
+        caught by ``test_spatially_sharded_train_step_matches_dp_with_perceptual``).
+        It is also simply the wrong layout: per-image work this small should
+        be parallelized over the batch, not rows. The constraint gathers H
+        and spreads the batch over every device (both mesh axes when the
+        batch divides evenly, else the data axis alone) for the VGG branch
+        only; WaterNet and the pixel losses stay spatially sharded upstream.
+        """
+        if self.mesh is None or self.mesh.shape[SPATIAL_AXIS] == 1:
+            return t
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        batch_axes = (
+            (DATA_AXIS, SPATIAL_AXIS)
+            if t.shape[0] % self.mesh.size == 0
+            else DATA_AXIS
+        )
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(self.mesh, P(batch_axes))
+        )
+
     def _losses_and_out(self, params, x, wbn, hen, gcn, refn, mask):
         out = self.model.apply(params, x, wbn, hen, gcn)
+        mse = mse_255(out, refn, mask)
         if self.config.perceptual_weight == 0.0:
             # VGG dominates step FLOPs; skip it entirely when unweighted.
-            from waternet_tpu.training.losses import mse_255
-
-            mse = mse_255(out, refn, mask)
             return mse, (out, {"mse": mse, "perceptual_loss": jnp.zeros(())})
-        loss, aux = composite_loss(
-            self.vgg, self.vgg_params, out, refn,
-            perceptual_weight=self.config.perceptual_weight,
-            mask=mask,
+        perc = perceptual_loss(
+            self.vgg, self.vgg_params,
+            self._unshard_spatial(out), self._unshard_spatial(refn),
+            mask,
         )
-        return loss, (out, aux)
+        loss = self.config.perceptual_weight * perc + mse
+        return loss, (out, {"mse": mse, "perceptual_loss": perc})
 
     def _metrics(self, out, refn, aux, mask, loss=None):
         m = {
