@@ -48,6 +48,14 @@ def parse_args(argv=None):
         "--name", type=str, help="(Optional) Subfolder name to save under `./output`."
     )
     parser.add_argument(
+        "--download",
+        action="store_true",
+        default=False,
+        help="(Optional) If no local weights are found, fetch the reference's "
+        "pretrained checkpoint (hash-verified, reference semantics). Off by "
+        "default: nothing downloads unless asked.",
+    )
+    parser.add_argument(
         "--show-split",
         action="store_true",
         default=False,
@@ -185,8 +193,15 @@ def main(argv=None):
         files = [source]
     print(f"Total images/videos: {len(files)}")
 
+    weights = args.weights
+    if weights is None and args.download:
+        from waternet_tpu.hub import download_weights, find_weights_path
+
+        if find_weights_path() is None:  # only touch the network when needed
+            weights = str(download_weights())
+
     engine = InferenceEngine(
-        weights=args.weights,
+        weights=weights,
         device_preprocess=args.device_preprocess,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         spatial_shards=args.spatial_shards,

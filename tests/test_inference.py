@@ -91,6 +91,44 @@ def test_hub_missing_weights_raises(monkeypatch, tmp_path):
         waternet(pretrained=True)
 
 
+def test_download_weights_hash_contract(tmp_path, monkeypatch):
+    """Reference-parity download semantics (hash prefix in filename, verify,
+    reuse, refuse), exercised via file:// URLs — no network involved."""
+    import hashlib
+
+    from waternet_tpu.hub import download_weights
+
+    monkeypatch.chdir(tmp_path)
+    payload = b"not really a checkpoint, but hashable"
+    digest = hashlib.sha256(payload).hexdigest()
+    src = tmp_path / f"waternet_exported_state_dict-{digest[:6]}.pt"
+    src.write_bytes(payload)
+    url = src.as_uri()
+
+    # full download + verify + rename flow
+    dest = download_weights(url, dest_dir=tmp_path / "weights")
+    assert dest.read_bytes() == payload
+    # second call reuses the verified file (delete the source to prove it)
+    src.unlink()
+    assert download_weights(url, dest_dir=tmp_path / "weights") == dest
+
+    # corrupted existing file is refused, not silently used or overwritten
+    dest.write_bytes(b"tampered")
+    with pytest.raises(RuntimeError, match="hash check"):
+        download_weights(url, dest_dir=tmp_path / "weights")
+
+    # wrong-hash download is deleted and raises
+    bad = tmp_path / "waternet_exported_state_dict-badbad.pt"
+    bad.write_bytes(payload)
+    with pytest.raises(RuntimeError, match="hash check"):
+        download_weights(bad.as_uri(), dest_dir=tmp_path / "w2")
+    assert not list((tmp_path / "w2").glob("*.pt"))
+
+    # URLs without a hash suffix are rejected up front
+    with pytest.raises(ValueError, match="no -<sha256-prefix>"):
+        download_weights("https://example.com/weights.pt", dest_dir=tmp_path)
+
+
 def test_video_stream_order_and_count(engine, tmp_path):
     cv2 = pytest.importorskip("cv2")
 

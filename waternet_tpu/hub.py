@@ -60,6 +60,98 @@ class JittedModel:
         return self._jitted(self.params, x, wb, ce, gc)
 
 
+# The reference's pretrained checkpoint (`/root/reference/hubconf.py:5`,
+# `inference.py:15-21`): the filename embeds the sha256 prefix that
+# torch.hub's check_hash verifies; download_weights reproduces exactly that
+# contract without torch.
+DEFAULT_CKPT_URL = (
+    "https://www.dropbox.com/s/j8ida1d86hy5tm4/"
+    "waternet_exported_state_dict-daa0ee.pt?dl=1"
+)
+
+
+def download_weights(
+    url: str = DEFAULT_CKPT_URL, dest_dir="weights", timeout: int = 60
+) -> Path:
+    """Opt-in pretrained-weight download with hash verification.
+
+    Mirrors the reference's ``torch.hub.load_state_dict_from_url(...,
+    check_hash=True)`` semantics (`/root/reference/inference.py:103-109`):
+    the expected sha256 *prefix* is parsed from the ``-<hex>`` suffix of the
+    URL's filename and the downloaded bytes must match it, else the file is
+    discarded and the call raises. An existing file that already matches is
+    reused without touching the network.
+
+    Zero-egress TPU environments are this framework's default posture, so
+    nothing calls this implicitly — it runs only via ``inference.py
+    --download``, ``waternet(..., download=True)``, or a direct call.
+    """
+    import hashlib
+    import re
+    import urllib.parse
+    import urllib.request
+
+    fname = Path(urllib.parse.urlparse(url).path).name
+    m = re.search(r"-([0-9a-f]{6,64})\.(?:pt|pth|npz)$", fname)
+    if m is None:
+        raise ValueError(
+            f"cannot verify download: no -<sha256-prefix> suffix in {fname!r}"
+        )
+    expect = m.group(1)
+
+    def _ok(path: Path) -> bool:
+        digest = hashlib.sha256(path.read_bytes()).hexdigest()
+        return digest.startswith(expect)
+
+    dest_dir = Path(dest_dir)
+    dest = dest_dir / fname
+    if dest.exists():
+        if _ok(dest):
+            return dest
+        raise RuntimeError(
+            f"existing file {dest} fails its hash check (expected sha256 "
+            f"prefix {expect}); refusing to overwrite or use it"
+        )
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    tmp = dest.with_suffix(dest.suffix + ".part")
+    with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+        while True:
+            chunk = r.read(1 << 20)
+            if not chunk:
+                break
+            f.write(chunk)
+    if not _ok(tmp):
+        tmp.unlink()
+        raise RuntimeError(
+            f"downloaded file from {url} fails its hash check "
+            f"(expected sha256 prefix {expect}); deleted"
+        )
+    tmp.rename(dest)
+    return dest
+
+
+def find_weights_path(search_dirs=(".", "weights")) -> Path | None:
+    """Locate (but do not load) the implicit-resolution weight candidate."""
+    candidates = []
+    for d in search_dirs:
+        d = Path(d)
+        if d.is_dir():
+            candidates.extend(sorted(d.glob("waternet_tpu-*.npz")))
+            candidates.extend(sorted(d.glob("waternet_exported_state_dict*.pt")))
+            # Broad fallback, excluding VGG19 perceptual-loss weight files
+            # which share these dirs (see resolve_vgg_params).
+            candidates.extend(
+                p
+                for pat in ("*.npz", "*.pt")
+                for p in sorted(d.glob(pat))
+                if not p.name.lower().startswith("vgg")
+            )
+    for c in candidates:
+        if c.exists() and c.suffix in (".npz", ".pt", ".pth"):
+            return c
+    return None
+
+
 def resolve_weights(weights=None, search_dirs=(".", "weights")) -> dict | None:
     """Find and load WaterNet weights. Returns a param pytree or None.
 
@@ -89,36 +181,15 @@ def resolve_weights(weights=None, search_dirs=(".", "weights")) -> dict | None:
     if env:
         return _load_strict(Path(env), "WATERNET_TPU_WEIGHTS")
 
-    candidates = []
-    for d in search_dirs:
-        d = Path(d)
-        if d.is_dir():
-            candidates.extend(sorted(d.glob("waternet_tpu-*.npz")))
-            candidates.extend(sorted(d.glob("waternet_exported_state_dict*.pt")))
-            # Broad fallback, excluding VGG19 perceptual-loss weight files
-            # which share these dirs (see resolve_vgg_params).
-            candidates.extend(
-                p
-                for pat in ("*.npz", "*.pt")
-                for p in sorted(d.glob(pat))
-                if not p.name.lower().startswith("vgg")
-            )
-    for c in candidates:
-        if not c.exists():
-            continue
-        if c.suffix == ".npz":
-            return load_weights(c)
-        if c.suffix in (".pt", ".pth"):
-            from waternet_tpu.utils.torch_port import waternet_params_from_torch
-
-            return waternet_params_from_torch(c)
-    return None
+    found = find_weights_path(search_dirs)
+    return _load_strict(found, "discovered") if found is not None else None
 
 
 def waternet(
     pretrained: bool = True,
     weights=None,
     dtype=jnp.float32,
+    download: bool = False,
 ) -> Tuple[Callable, Callable, JittedModel]:
     """Build the (preprocess, postprocess, model) triple.
 
@@ -128,6 +199,9 @@ def waternet(
             randomly initialized model.
         weights: optional explicit path (.npz ours, or reference .pt).
         dtype: compute dtype for the model (bfloat16 recommended on TPU).
+        download: opt in to fetching the reference's pretrained checkpoint
+            (hash-verified, see :func:`download_weights`) when no local
+            weights are found. Off by default: zero-egress posture.
     """
     from waternet_tpu.utils.platform import ensure_platform
 
@@ -135,13 +209,16 @@ def waternet(
     module = WaterNet(dtype=dtype)
     if pretrained:
         params = resolve_weights(weights)
+        if params is None and download:
+            params = resolve_weights(download_weights())
         if params is None:
             raise FileNotFoundError(
                 "No WaterNet weights found. Provide `weights=...`, set "
                 "WATERNET_TPU_WEIGHTS, or place waternet_tpu-*.npz / the "
-                "reference's waternet_exported_state_dict-*.pt in ./weights. "
-                "(This framework does not download weights: TPU environments "
-                "are commonly egress-less; fetch once and ship the file.)"
+                "reference's waternet_exported_state_dict-*.pt in ./weights; "
+                "or opt in to a hash-verified fetch with download=True "
+                "(CLI: --download). Nothing downloads by default: TPU "
+                "environments are commonly egress-less."
             )
     else:
         zeros = jnp.zeros((1, 32, 32, 3), jnp.float32)

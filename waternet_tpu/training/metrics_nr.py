@@ -15,8 +15,13 @@ underwater-enhancement literature:
   ``0.0282 * UICM + 0.2953 * UISM + 3.5753 * UIConM``.
 
 Both are pure JAX (jittable, vmappable). Implementations follow the common
-open-source formulations; absolute values match the literature's ballpark
-and are primarily meaningful for *comparisons* (raw vs enhanced).
+normalized open-source formulations (8-bit LAB scaled by 1/255; Michelson-
+entropy UIConM without the PLIP operators) and are pinned against an
+independent float64 numpy/cv2 implementation with hard-coded golden values
+in ``tests/test_metrics_nr.py::test_nr_metrics_golden_values``. Absolute
+values are paper-ballpark (~0.3-0.6 UCIQE); cross-*implementation*
+comparisons remain sensitive to these conventions, so comparisons across
+papers should re-score with one implementation.
 """
 
 from __future__ import annotations
