@@ -127,6 +127,46 @@ def test_clahe_core_bitexact_fuzz_shapes(rng):
         )
 
 
+def test_clahe_matmul_interp_bitexact(rng, monkeypatch):
+    """The MXU one-hot-matmul interpolation path (half-tile cells, bf16
+    one-hot batched matmul) must stay bit-exact vs cv2 wherever it engages
+    (even tile sizes), and fall back to the gather path safely elsewhere
+    (odd tiles / f32-rounding-split cells)."""
+    import cv2
+
+    from waternet_tpu.ops.clahe import clahe
+
+    import importlib
+
+    # waternet_tpu.ops lazily re-exports the clahe *function*, which shadows
+    # the submodule under plain ``import ... as``; resolve the module itself.
+    clahe_mod = importlib.import_module("waternet_tpu.ops.clahe")
+
+    monkeypatch.setenv("WATERNET_CLAHE_INTERP", "matmul")
+    engaged = []
+    real_planes = clahe_mod._lut_planes_matmul
+    monkeypatch.setattr(
+        clahe_mod,
+        "_lut_planes_matmul",
+        lambda *a, **k: (engaged.append(True) or real_planes(*a, **k)),
+    )
+    cl = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8))
+    # (112,112)/(16,16)/(96,112) engage the matmul (even tiles after pad);
+    # (56,56)/(45,83)/(64,200)/(131,97) exercise the odd-tile fallback.
+    shapes = [(112, 112), (16, 16), (96, 112), (56, 56),
+              (45, 83), (64, 200), (131, 97)]
+    for h, w in shapes:
+        lum = rng.integers(0, 256, size=(h, w), dtype=np.uint8)
+        want = cl.apply(lum)
+        engaged.clear()
+        got = np.asarray(clahe(lum.astype(np.float32)))
+        expect_matmul = (h, w) in [(112, 112), (16, 16), (96, 112)]
+        assert bool(engaged) == expect_matmul, f"mode for {(h, w)}"
+        np.testing.assert_array_equal(
+            got, want.astype(np.float32), err_msg=f"shape {(h, w)}"
+        )
+
+
 def test_lab_conversion_close_to_cv2(sample_rgb):
     import cv2
 

@@ -35,6 +35,7 @@ bounded by tolerance tests; the host path remains the parity path.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,6 +64,105 @@ def histeq_np(rgb: np.ndarray) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # Device path (pure JAX).
 # ---------------------------------------------------------------------------
+
+
+# Auto mode caps the materialized (cells, pix, 256) bf16 one-hot at 64 MB
+# per image (the histogram stage deliberately avoids exactly this kind of
+# blowup at 1080p — module docstring step 2). Above the cap the per-pixel
+# gather path wins on memory; WATERNET_CLAHE_INTERP=matmul overrides for
+# benchmarking.
+_MATMUL_ONEHOT_CAP_BYTES = 64 * 1024 * 1024
+
+
+def _interp_mode(th: int, tw: int, hp: int, wp: int) -> str:
+    """Resolve the LUT-interpolation strategy: 'gather' or 'matmul'.
+
+    ``WATERNET_CLAHE_INTERP`` forces a mode; auto picks the one-hot matmul
+    on TPU (gathers serialize on TPU; a one-hot bf16 matmul rides the MXU)
+    when the tile size is even (the half-tile cell decomposition needs it)
+    and the one-hot operand stays under ``_MATMUL_ONEHOT_CAP_BYTES``,
+    else the gather path.
+    """
+    if th % 2 or tw % 2:
+        return "gather"  # odd tiles can't split into half-tile cells
+    import os
+
+    forced = os.environ.get("WATERNET_CLAHE_INTERP", "").strip().lower()
+    if forced in ("gather", "matmul"):
+        return forced
+    if hp * wp * 256 * 2 > _MATMUL_ONEHOT_CAP_BYTES:
+        return "gather"
+    return "matmul" if jax.default_backend() == "tpu" else "gather"
+
+
+def _cell_tile_indices(n_pix, tile, n_tiles):
+    """Per-half-tile-cell (lo, hi) tile indices, or None.
+
+    Reproduces the runtime grid arithmetic exactly — float32 multiply by the
+    float32 reciprocal, minus 0.5, floor — in numpy at trace time (IEEE f32
+    elementwise ops are bit-identical between numpy and XLA), then checks
+    that every pixel of each half-tile cell landed on the same tile pair.
+    A None return means f32 rounding moved a boundary into a cell interior
+    for this shape, and the caller must use the per-pixel gather path to
+    stay bit-exact with OpenCV."""
+    half = tile // 2
+    inv = np.float32(1.0) / np.float32(tile)
+    coords = np.arange(n_pix, dtype=np.float32) * inv - np.float32(0.5)
+    f = np.floor(coords).astype(np.int64).reshape(-1, half)
+    if not (f == f[:, :1]).all():
+        return None
+    lo = f[:, 0]
+    hi = np.minimum(lo + 1, n_tiles - 1)
+    lo = np.maximum(lo, 0)
+    return lo, hi
+
+
+def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, th, tw):
+    """The four quadrant LUT lookups as one batched one-hot matmul.
+
+    The (padded) image splits into (2*ty, 2*tx) half-tile cells; every pixel
+    in a cell interpolates between the SAME four tile LUTs (the cell index
+    determines floor(y/th - 0.5) etc.). Stacking those four 256-entry LUTs
+    per cell gives a (cells, 256, 4) operand, and the pixel values become a
+    (cells, pix, 256) one-hot; a bf16 batched matmul then performs all four
+    lookups per pixel on the MXU. Exact: each output element is a single
+    1.0 * lut product (LUT values are integers <= 255, exactly representable
+    in bf16), so the result is bit-identical to the gather path.
+
+    Returns four (hp, wp) float32 planes (quadrants 11, 12, 21, 22).
+    """
+    hp, wp = v_pad.shape
+    th2, tw2 = th // 2, tw // 2
+    y1, y2 = cells_y
+    x1, x2 = cells_x
+    ncy, ncx = len(y1), len(x1)
+
+    def tab(yi, xi):  # (ncy, ncx, 256)
+        return luts[yi[:, None], xi[None, :], :]
+
+    tables = jnp.stack(
+        [tab(y1, x1), tab(y1, x2), tab(y2, x1), tab(y2, x2)], axis=-1
+    )  # (ncy, ncx, 256, 4)
+    tables = tables.reshape(ncy * ncx, 256, 4).astype(jnp.bfloat16)
+
+    cells = (
+        v_pad.reshape(ncy, th2, ncx, tw2)
+        .transpose(0, 2, 1, 3)
+        .reshape(ncy * ncx, th2 * tw2)
+    )
+    onehot = jax.nn.one_hot(cells, 256, dtype=jnp.bfloat16)
+    looked = jax.lax.dot_general(
+        onehot,
+        tables,
+        (((2,), (1,)), ((0,), (0,))),  # contract over the 256 bins, batch cells
+        preferred_element_type=jnp.float32,
+    )  # (cells, pix, 4)
+    planes = (
+        looked.reshape(ncy, ncx, th2, tw2, 4)
+        .transpose(4, 0, 2, 1, 3)
+        .reshape(4, hp, wp)
+    )
+    return planes[0], planes[1], planes[2], planes[3]
 
 
 def clahe(
@@ -128,32 +228,54 @@ def clahe(
     luts = jnp.clip(jnp.round(cdf * lut_scale), 0.0, 255.0)  # (T, 256)
     luts = luts.reshape(ty, tx, 256)
 
-    # --- bilinear interpolation between tile LUTs (over the original area) ---
+    # --- bilinear interpolation between tile LUTs ---
+    # (gather: over the original (h, w) area; matmul: over the padded
+    # (hp, wp) grid, cropped to (h, w) after the blend — elementwise
+    # identical on the kept region.)
     # OpenCV computes tile coords as x * (1/tile_size) with a float32
     # reciprocal (not a division); matching that exactly is what makes the
     # rounding ties land identically (verified bit-exact vs cv2).
+    mode = _interp_mode(th, tw, hp, wp)
+    cells_y = cells_x = None
+    if mode == "matmul":
+        cells_y = _cell_tile_indices(hp, th, ty)
+        cells_x = _cell_tile_indices(wp, tw, tx)
+        if cells_y is None or cells_x is None:
+            mode = "gather"  # f32 rounding split a cell; stay exact
+    gh, gw = (h, w) if mode == "gather" else (hp, wp)
     inv_th = np.float32(1.0) / np.float32(th)
     inv_tw = np.float32(1.0) / np.float32(tw)
-    yy = jnp.arange(h, dtype=jnp.float32) * inv_th - np.float32(0.5)
-    xx = jnp.arange(w, dtype=jnp.float32) * inv_tw - np.float32(0.5)
+    yy = jnp.arange(gh, dtype=jnp.float32) * inv_th - np.float32(0.5)
+    xx = jnp.arange(gw, dtype=jnp.float32) * inv_tw - np.float32(0.5)
     y1 = jnp.floor(yy).astype(jnp.int32)
     x1 = jnp.floor(xx).astype(jnp.int32)
     ya = (yy - y1.astype(jnp.float32))[:, None]
     xa = (xx - x1.astype(jnp.float32))[None, :]
-    y2 = jnp.minimum(y1 + 1, ty - 1)
-    x2 = jnp.minimum(x1 + 1, tx - 1)
-    y1 = jnp.maximum(y1, 0)
-    x1 = jnp.maximum(x1, 0)
 
-    v = l_chan.astype(jnp.int32)
+    if mode == "matmul":
+        # All four lookups as one MXU one-hot matmul over half-tile cells
+        # (bit-identical values; see _lut_planes_matmul), computed on the
+        # padded grid and cropped after the blend.
+        p11, p12, p21, p22 = _lut_planes_matmul(luts, x, cells_y, cells_x, th, tw)
+        res = (p11 * (1.0 - xa) + p12 * xa) * (1.0 - ya) + (
+            p21 * (1.0 - xa) + p22 * xa
+        ) * ya
+        res = res[:h, :w]
+    else:
+        y2 = jnp.minimum(y1 + 1, ty - 1)
+        x2 = jnp.minimum(x1 + 1, tx - 1)
+        y1 = jnp.maximum(y1, 0)
+        x1 = jnp.maximum(x1, 0)
 
-    def look(yi, xi):
-        # luts[yi[r], xi[c], v[r, c]] for every pixel.
-        return luts[yi[:, None], xi[None, :], v]
+        v = l_chan.astype(jnp.int32)
 
-    res = (look(y1, x1) * (1.0 - xa) + look(y1, x2) * xa) * (1.0 - ya) + (
-        look(y2, x1) * (1.0 - xa) + look(y2, x2) * xa
-    ) * ya
+        def look(yi, xi):
+            # luts[yi[r], xi[c], v[r, c]] for every pixel.
+            return luts[yi[:, None], xi[None, :], v]
+
+        res = (look(y1, x1) * (1.0 - xa) + look(y1, x2) * xa) * (1.0 - ya) + (
+            look(y2, x1) * (1.0 - xa) + look(y2, x2) * xa
+        ) * ya
     return jnp.clip(jnp.round(res), 0.0, 255.0)
 
 
