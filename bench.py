@@ -27,6 +27,11 @@ BATCH = int(os.environ.get("WATERNET_BENCH_BATCH", 16))
 HW = int(os.environ.get("WATERNET_BENCH_HW", 112))
 WARMUP_STEPS = int(os.environ.get("WATERNET_BENCH_WARMUP", 3))
 MEASURE_STEPS = int(os.environ.get("WATERNET_BENCH_STEPS", 30))
+PRECISION = os.environ.get("WATERNET_BENCH_PRECISION", "bf16")
+if PRECISION not in ("bf16", "fp32"):
+    raise SystemExit(
+        f"WATERNET_BENCH_PRECISION must be 'bf16' or 'fp32', got {PRECISION!r}"
+    )
 
 # Dense bf16 peak TFLOP/s per chip, by PJRT device_kind substring (public
 # cloud.google.com/tpu spec sheet numbers). MFU is computed against this;
@@ -192,7 +197,7 @@ def main():
     from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
 
     config = TrainConfig(
-        batch_size=BATCH, im_height=HW, im_width=HW, precision="bf16"
+        batch_size=BATCH, im_height=HW, im_width=HW, precision=PRECISION
     )
     engine = TrainingEngine(config)
 
@@ -262,7 +267,7 @@ def main():
         "device_kind": getattr(dev, "device_kind", str(dev)),
         "batch": BATCH,
         "hw": HW,
-        "precision": "bf16",
+        "precision": PRECISION,
     }
     print(json.dumps(line))
 

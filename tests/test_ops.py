@@ -75,6 +75,22 @@ def test_wb_device_close_to_host(sample_rgb):
     assert (np.abs(dev - host) > 0).mean() < 0.01
 
 
+def test_wb_device_histogram_quantiles_fuzz(rng):
+    """The histogram-CDF order statistics must track the host float64
+    quantiles across random and degenerate inputs (all-black channel,
+    constant channel, tiny images)."""
+    cases = [rng.integers(0, 256, (31, 47, 3), dtype=np.uint8) for _ in range(3)]
+    blk = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
+    blk[..., 2] = 0  # all-black channel (degenerate sat guard)
+    cst = np.full((8, 8, 3), 77, dtype=np.uint8)  # constant channels
+    tiny = rng.integers(0, 256, (2, 3, 3), dtype=np.uint8)
+    for img in cases + [blk, cst, tiny]:
+        host = white_balance_np(img).astype(np.float32)
+        dev = np.asarray(white_balance(img))
+        assert np.abs(dev - host).max() <= 1.0, img.shape
+        assert (np.abs(dev - host) > 0).mean() < 0.02, img.shape
+
+
 def test_gamma_device_exact(sample_rgb):
     host = gamma_correction_np(sample_rgb).astype(np.float32)
     dev = np.asarray(gamma_correction(sample_rgb))

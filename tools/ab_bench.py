@@ -1,0 +1,118 @@
+"""A/B benchmark sweep: every perf-relevant variant in one sequential run.
+
+Runs `bench.py` repeatedly as *sequential* subprocesses (never two at once —
+a second concurrent client wedges the single-chip accelerator tunnel) and
+collects each one-line JSON result into one report. Use it the moment the
+chip is reachable to settle the open measurement questions from VERDICT.md:
+
+* CLAHE LUT interpolation: gather vs one-hot MXU matmul
+  (``WATERNET_CLAHE_INTERP``) — decides the device-path default;
+* CLAHE histograms: XLA scatter-add vs Pallas comparison-reduction kernel
+  (``WATERNET_PALLAS=1``) — decides whether the Pallas kernel stays;
+* bf16 vs fp32 step time (``WATERNET_BENCH_PRECISION``);
+* 1080p video throughput across device batch sizes 2/4/8.
+
+Usage::
+
+    python tools/ab_bench.py [--out docs/bench_ab.json] [--skip-video]
+
+A fast accelerator probe runs first; if the tunnel is down the sweep aborts
+immediately instead of burning a 180s timeout per variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+TRAIN_VARIANTS = [
+    ("default_bf16", {}),
+    ("clahe_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
+    ("clahe_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
+    ("pallas_hist", {"WATERNET_PALLAS": "1"}),
+    ("fp32", {"WATERNET_BENCH_PRECISION": "fp32"}),
+]
+VIDEO_BATCHES = (2, 4, 8)
+
+
+def run_bench(extra_env, args=()):
+    env = dict(os.environ)
+    env.update(extra_env)
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), *args],
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=REPO,
+            timeout=1800,
+        )
+    except subprocess.TimeoutExpired:
+        # Mid-sweep tunnel wedge (client retries forever, no error): record
+        # it against this variant and let the remaining variants try — the
+        # next bench.py's own probe will fail fast if the chip stays gone.
+        return {
+            "error": "bench.py exceeded 1800s (tunnel wedged mid-run?)",
+            "wall_sec": round(time.perf_counter() - t0, 1),
+        }
+    wall = time.perf_counter() - t0
+    line = None
+    for out_line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            line = json.loads(out_line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if line is None:
+        line = {
+            "error": "no JSON line",
+            "rc": proc.returncode,
+            "stderr_tail": proc.stderr.strip().splitlines()[-3:],
+        }
+    line["wall_sec"] = round(wall, 1)
+    return line
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=str(REPO / "docs" / "bench_ab.json"))
+    p.add_argument("--skip-video", action="store_true")
+    p.add_argument(
+        "--probe-timeout", type=int, default=90,
+        help="seconds to wait for device init before aborting the sweep",
+    )
+    args = p.parse_args()
+
+    sys.path.insert(0, str(REPO))
+    from bench import _probe_accelerator
+
+    err = _probe_accelerator(timeout_s=args.probe_timeout)
+    if err is not None:
+        print(f"[ab_bench] aborting, accelerator unreachable: {err}", file=sys.stderr)
+        raise SystemExit(1)
+
+    report = {"variants": {}, "video": {}}
+    for name, env in TRAIN_VARIANTS:
+        print(f"[ab_bench] train variant: {name}", file=sys.stderr)
+        report["variants"][name] = run_bench(env)
+        Path(args.out).write_text(json.dumps(report, indent=2))
+    if not args.skip_video:
+        for b in VIDEO_BATCHES:
+            print(f"[ab_bench] video batch {b}", file=sys.stderr)
+            report["video"][f"batch{b}"] = run_bench(
+                {}, ("--config", "video", "--batch-size", str(b))
+            )
+            Path(args.out).write_text(json.dumps(report, indent=2))
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
