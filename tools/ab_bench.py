@@ -34,8 +34,10 @@ REPO = Path(__file__).resolve().parent.parent
 
 TRAIN_VARIANTS = [
     ("default_bf16", {}),
-    ("clahe_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
-    ("clahe_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
+    ("clahe_interp_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
+    ("clahe_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
+    ("clahe_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter"}),
+    ("clahe_hist_matmul", {"WATERNET_CLAHE_HIST": "matmul"}),
     ("pallas_hist", {"WATERNET_PALLAS": "1"}),
     ("fp32", {"WATERNET_BENCH_PRECISION": "fp32"}),
 ]

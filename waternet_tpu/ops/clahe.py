@@ -12,8 +12,11 @@ OpenCV's CLAHE algorithm (modules/imgproc/src/clahe.cpp), exact in the integer
 pipeline given the same L input:
 
 1. Pad right/bottom with reflect-101 so H, W divide the tile grid.
-2. Per-tile 256-bin histograms (scatter-add — avoids a (tiles, pixels, 256)
-   one-hot blowup at 1080p).
+2. Per-tile 256-bin histograms, three strategies (``WATERNET_CLAHE_HIST`` /
+   ``use_pallas``): XLA scatter-add (CPU default; no intermediate),
+   one-hot MXU matmul (TPU default while the (tiles, pixels, 256) bf16
+   one-hot stays under a 64 MB cap — above it, e.g. 1080p frames, scatter
+   avoids the blowup), or the Pallas VPU comparison-reduction kernel.
 3. Integer clip limit ``max(int(clipLimit * tileArea / 256), 1)`` — note with
    the reference's clipLimit=0.1 this is the minimum value 1, i.e. maximal
    clipping: the equalization mostly rank-equalizes the *distinct* gray
@@ -93,6 +96,65 @@ def _interp_mode(th: int, tw: int, hp: int, wp: int) -> str:
     if hp * wp * 256 * 2 > _MATMUL_ONEHOT_CAP_BYTES:
         return "gather"
     return "matmul" if jax.default_backend() == "tpu" else "gather"
+
+
+def _hist_mode(use_pallas, n_tiles, tile_area) -> str:
+    """Resolve the histogram strategy: 'scatter', 'matmul', or 'pallas'.
+
+    ``use_pallas=True`` (or ``WATERNET_PALLAS=1``) selects the Pallas VPU
+    comparison-reduction kernel. ``WATERNET_CLAHE_HIST`` forces any mode.
+    Auto prefers the one-hot MXU matmul on TPU (bincount lowers to a
+    serialized scatter-add there) while the one-hot operand stays under the
+    same 64 MB cap as the interpolation; CPU keeps scatter (fast there).
+    """
+    import os
+
+    # Explicit argument wins over the env override (an exported
+    # WATERNET_CLAHE_HIST must not silently reroute callers — or tests —
+    # that pin a path via use_pallas=...).
+    if use_pallas is not None:
+        return "pallas" if use_pallas else "scatter"
+    forced = os.environ.get("WATERNET_CLAHE_HIST", "").strip().lower()
+    if forced in ("scatter", "matmul", "pallas"):
+        return forced
+    from waternet_tpu.ops.pallas_kernels import pallas_enabled
+
+    if pallas_enabled():
+        return "pallas"
+    if (
+        jax.default_backend() == "tpu"
+        and n_tiles * tile_area * 256 * 2 <= _MATMUL_ONEHOT_CAP_BYTES
+    ):
+        return "matmul"
+    return "scatter"
+
+
+def _tile_hist(tiles, use_pallas):
+    """(T, A) int values in [0, 256) -> (T, 256) integer counts."""
+    n_tiles, tile_area = tiles.shape
+    mode = _hist_mode(use_pallas, n_tiles, tile_area)
+    if mode == "pallas":
+        # Dense VPU comparison-reduction kernel (scatter-free).
+        from waternet_tpu.ops.pallas_kernels import tile_histogram
+
+        return tile_histogram(tiles)
+    if mode == "matmul":
+        # hist[t, b] = ones(A) . onehot[t, :, b] — one bf16 batched matmul
+        # on the MXU with f32 accumulation (exact: 0/1 products, integer
+        # sums < 2^24).
+        onehot = jax.nn.one_hot(tiles, 256, dtype=jnp.bfloat16)
+        ones = jnp.ones((n_tiles, 1, tile_area), jnp.bfloat16)
+        counts = jax.lax.dot_general(
+            ones,
+            onehot,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (T, 1, 256)
+        return counts[:, 0, :].astype(jnp.int32)
+    # XLA scatter path: bincount lowers to scatter-add.
+    tile_ids = jnp.repeat(jnp.arange(n_tiles, dtype=jnp.int32), tile_area)
+    flat_idx = tile_ids * 256 + tiles.reshape(-1)
+    return jnp.bincount(flat_idx, length=n_tiles * 256).reshape(n_tiles, 256)
 
 
 def _cell_tile_indices(n_pix, tile, n_tiles):
@@ -192,20 +254,7 @@ def clahe(
 
     # --- per-tile histograms ---
     tiles = x.reshape(ty, th, tx, tw).transpose(0, 2, 1, 3).reshape(n_tiles, tile_area)
-    if use_pallas is None:
-        from waternet_tpu.ops.pallas_kernels import pallas_enabled
-
-        use_pallas = pallas_enabled()
-    if use_pallas:
-        # Dense VPU comparison-reduction kernel (scatter-free).
-        from waternet_tpu.ops.pallas_kernels import tile_histogram
-
-        hist = tile_histogram(tiles)
-    else:
-        # XLA path: bincount lowers to scatter-add.
-        tile_ids = jnp.repeat(jnp.arange(n_tiles, dtype=jnp.int32), tile_area)
-        flat_idx = tile_ids * 256 + tiles.reshape(-1)
-        hist = jnp.bincount(flat_idx, length=n_tiles * 256).reshape(n_tiles, 256)
+    hist = _tile_hist(tiles, use_pallas)
 
     # --- clip + redistribute (OpenCV integer semantics) ---
     clip = max(int(clip_limit * tile_area / 256.0), 1)
