@@ -93,6 +93,32 @@ def test_clahe_matmul_hist_bitexact(rng, monkeypatch):
         )
 
 
+def test_clahe_matmul_hist_chunked_bitexact(rng, monkeypatch):
+    """Large tiles must route through the lax.scan-chunked one-hot matmul
+    (bounded memory) and still match cv2 bit-for-bit. A tiny cap forces
+    multiple chunks even at test sizes."""
+    import importlib
+
+    import cv2
+
+    clahe_mod = importlib.import_module("waternet_tpu.ops.clahe")
+    monkeypatch.setenv("WATERNET_CLAHE_HIST", "matmul")
+    monkeypatch.setattr(clahe_mod, "_MATMUL_ONEHOT_CAP_BYTES", 256 * 1024)
+    # 256x256 -> tile_area 1024 > chunk floor 256, so the lax.scan body,
+    # -1 padding, and transpose genuinely execute (spy asserts it).
+    chunked = []
+    real_count = clahe_mod.jax.lax.scan
+    monkeypatch.setattr(
+        clahe_mod.jax.lax, "scan",
+        lambda *a, **k: (chunked.append(True) or real_count(*a, **k)),
+    )
+    lum = rng.integers(0, 256, size=(256, 256), dtype=np.uint8)
+    want = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8)).apply(lum)
+    got = np.asarray(clahe_mod.clahe(lum.astype(np.float32)))
+    assert chunked, "scan-chunked path did not engage"
+    np.testing.assert_array_equal(got, want.astype(np.float32))
+
+
 def test_wb_device_histogram_quantiles_fuzz():
     """The histogram-CDF order statistics must track the host float64
     quantiles across random and degenerate inputs (all-black channel,

@@ -14,9 +14,9 @@ pipeline given the same L input:
 1. Pad right/bottom with reflect-101 so H, W divide the tile grid.
 2. Per-tile 256-bin histograms, three strategies (``WATERNET_CLAHE_HIST`` /
    ``use_pallas``): XLA scatter-add (CPU default; no intermediate),
-   one-hot MXU matmul (TPU default while the (tiles, pixels, 256) bf16
-   one-hot stays under a 64 MB cap — above it, e.g. 1080p frames, scatter
-   avoids the blowup), or the Pallas VPU comparison-reduction kernel.
+   one-hot MXU matmul (TPU default; lax.scan-chunked so the bf16 one-hot
+   stays under a 64 MB cap at any frame size), or the Pallas VPU
+   comparison-reduction kernel.
 3. Integer clip limit ``max(int(clipLimit * tileArea / 256), 1)`` — note with
    the reference's clipLimit=0.1 this is the minimum value 1, i.e. maximal
    clipping: the equalization mostly rank-equalizes the *distinct* gray
@@ -98,14 +98,15 @@ def _interp_mode(th: int, tw: int, hp: int, wp: int) -> str:
     return "matmul" if jax.default_backend() == "tpu" else "gather"
 
 
-def _hist_mode(use_pallas, n_tiles, tile_area) -> str:
+def _hist_mode(use_pallas) -> str:
     """Resolve the histogram strategy: 'scatter', 'matmul', or 'pallas'.
 
     ``use_pallas=True`` (or ``WATERNET_PALLAS=1``) selects the Pallas VPU
     comparison-reduction kernel. ``WATERNET_CLAHE_HIST`` forces any mode.
     Auto prefers the one-hot MXU matmul on TPU (bincount lowers to a
-    serialized scatter-add there) while the one-hot operand stays under the
-    same 64 MB cap as the interpolation; CPU keeps scatter (fast there).
+    serialized scatter-add there); the matmul chunks itself under the 64 MB
+    one-hot cap, so it handles any frame size. CPU keeps scatter (fast
+    there).
     """
     import os
 
@@ -121,10 +122,7 @@ def _hist_mode(use_pallas, n_tiles, tile_area) -> str:
 
     if pallas_enabled():
         return "pallas"
-    if (
-        jax.default_backend() == "tpu"
-        and n_tiles * tile_area * 256 * 2 <= _MATMUL_ONEHOT_CAP_BYTES
-    ):
+    if jax.default_backend() == "tpu":
         return "matmul"
     return "scatter"
 
@@ -132,25 +130,45 @@ def _hist_mode(use_pallas, n_tiles, tile_area) -> str:
 def _tile_hist(tiles, use_pallas):
     """(T, A) int values in [0, 256) -> (T, 256) integer counts."""
     n_tiles, tile_area = tiles.shape
-    mode = _hist_mode(use_pallas, n_tiles, tile_area)
+    mode = _hist_mode(use_pallas)
     if mode == "pallas":
         # Dense VPU comparison-reduction kernel (scatter-free).
         from waternet_tpu.ops.pallas_kernels import tile_histogram
 
         return tile_histogram(tiles)
     if mode == "matmul":
-        # hist[t, b] = ones(A) . onehot[t, :, b] — one bf16 batched matmul
-        # on the MXU with f32 accumulation (exact: 0/1 products, integer
-        # sums < 2^24).
-        onehot = jax.nn.one_hot(tiles, 256, dtype=jnp.bfloat16)
-        ones = jnp.ones((n_tiles, 1, tile_area), jnp.bfloat16)
-        counts = jax.lax.dot_general(
-            ones,
-            onehot,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )  # (T, 1, 256)
-        return counts[:, 0, :].astype(jnp.int32)
+        # hist[t, b] = ones(A) . onehot[t, :, b] — bf16 batched matmuls on
+        # the MXU with f32 accumulation (exact: 0/1 products, integer sums
+        # < 2^24). Large tiles (1080p: 32k+ px) are chunked with lax.scan
+        # so the materialized one-hot stays bounded regardless of frame
+        # size — the pure-XLA analog of the Pallas kernel's chunking.
+        chunk = tile_area
+        if n_tiles * tile_area * 256 * 2 > _MATMUL_ONEHOT_CAP_BYTES:
+            chunk = max(_MATMUL_ONEHOT_CAP_BYTES // (n_tiles * 256 * 2), 256)
+
+        def _count(vals):  # (T, chunk) int32, -1 marks padding
+            onehot = jax.nn.one_hot(vals, 256, dtype=jnp.bfloat16)
+            ones = jnp.ones((n_tiles, 1, vals.shape[1]), jnp.bfloat16)
+            counts = jax.lax.dot_general(
+                ones,
+                onehot,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )  # (T, 1, 256)
+            return counts[:, 0, :]
+
+        if chunk >= tile_area:
+            return _count(tiles).astype(jnp.int32)
+        n_chunks = -(-tile_area // chunk)
+        pad = n_chunks * chunk - tile_area
+        vals = jnp.pad(tiles, ((0, 0), (0, pad)), constant_values=-1)
+        vals = vals.reshape(n_tiles, n_chunks, chunk).transpose(1, 0, 2)
+
+        def body(acc, v):
+            return acc + _count(v), None
+
+        hist, _ = jax.lax.scan(body, jnp.zeros((n_tiles, 256), jnp.float32), vals)
+        return hist.astype(jnp.int32)
     # XLA scatter path: bincount lowers to scatter-add.
     tile_ids = jnp.repeat(jnp.arange(n_tiles, dtype=jnp.int32), tile_area)
     flat_idx = tile_ids * 256 + tiles.reshape(-1)
