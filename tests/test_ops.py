@@ -214,20 +214,55 @@ def test_clahe_matmul_interp_bitexact(rng, monkeypatch):
         lambda *a, **k: (engaged.append(True) or real_planes(*a, **k)),
     )
     cl = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8))
-    # (112,112)/(16,16)/(96,112) engage the matmul (even tiles after pad);
-    # (56,56)/(45,83)/(64,200)/(131,97) exercise the odd-tile fallback.
+    # Even tiles use half-tile cells; odd tiles degrade to single-row/
+    # column cells; the cap subdivides over-tall cells — every shape
+    # engages, and every one must stay cv2-bit-exact.
     shapes = [(112, 112), (16, 16), (96, 112), (56, 56),
-              (45, 83), (64, 200), (131, 97)]
+              (45, 83), (64, 200), (131, 97), (200, 200)]
     for h, w in shapes:
         lum = rng.integers(0, 256, size=(h, w), dtype=np.uint8)
         want = cl.apply(lum)
         engaged.clear()
         got = np.asarray(clahe(lum.astype(np.float32)))
-        expect_matmul = (h, w) in [(112, 112), (16, 16), (96, 112)]
-        assert bool(engaged) == expect_matmul, f"mode for {(h, w)}"
+        assert engaged, f"matmul interp did not engage for {(h, w)}"
         np.testing.assert_array_equal(
             got, want.astype(np.float32), err_msg=f"shape {(h, w)}"
         )
+
+
+def test_clahe_matmul_interp_chunked_bitexact(rng, monkeypatch):
+    """A tiny one-hot cap forces the interpolation's lax.scan row-group
+    path; results must stay cv2-bit-exact and the scan must engage."""
+    import importlib
+
+    import cv2
+
+    clahe_mod = importlib.import_module("waternet_tpu.ops.clahe")
+    monkeypatch.setenv("WATERNET_CLAHE_INTERP", "matmul")
+    monkeypatch.setenv("WATERNET_CLAHE_HIST", "scatter")  # isolate interp
+    monkeypatch.setattr(clahe_mod, "_MATMUL_ONEHOT_CAP_BYTES", 512 * 1024)
+    chunked = []
+    real_scan = clahe_mod.jax.lax.scan
+    monkeypatch.setattr(
+        clahe_mod.jax.lax, "scan",
+        lambda *a, **k: (chunked.append(True) or real_scan(*a, **k)),
+    )
+    h, w = 112, 112
+    lum = rng.integers(0, 256, size=(h, w), dtype=np.uint8)
+    want = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8)).apply(lum)
+    got = np.asarray(clahe_mod.clahe(lum.astype(np.float32)))
+    assert chunked, "scan-chunked interp did not engage"
+    np.testing.assert_array_equal(got, want.astype(np.float32))
+
+    # Degenerate cap (one cell-row's LUT tables can't fit): must fall back
+    # to gather and stay exact.
+    monkeypatch.setattr(clahe_mod, "_MATMUL_ONEHOT_CAP_BYTES", 16 * 1024)
+    lum2 = rng.integers(0, 256, size=(131, 97), dtype=np.uint8)
+    want2 = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8)).apply(lum2)
+    chunked.clear()
+    got2 = np.asarray(clahe_mod.clahe(lum2.astype(np.float32)))
+    assert not chunked, "expected gather fallback under degenerate cap"
+    np.testing.assert_array_equal(got2, want2.astype(np.float32))
 
 
 def test_lab_conversion_close_to_cv2(sample_rgb):

@@ -69,32 +69,33 @@ def histeq_np(rgb: np.ndarray) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-# Auto mode caps the materialized (cells, pix, 256) bf16 one-hot at 64 MB
-# per image (the histogram stage deliberately avoids exactly this kind of
-# blowup at 1080p — module docstring step 2). Above the cap the per-pixel
-# gather path wins on memory; WATERNET_CLAHE_INTERP=matmul overrides for
-# benchmarking.
+# Per-step operand budget for the matmul paths: the histogram chunks its
+# one-hot, and the interpolation sizes its cell decomposition (cell-height
+# subdivision) and lax.scan row groups so that neither the bf16 one-hot nor
+# the per-group LUT tables exceed this at any frame size. Tuning it trades
+# scan length against peak memory; it does NOT switch gather/matmul except
+# in the degenerate case where even single-pixel-cell rows can't fit
+# (see clahe()).
 _MATMUL_ONEHOT_CAP_BYTES = 64 * 1024 * 1024
 
 
-def _interp_mode(th: int, tw: int, hp: int, wp: int) -> str:
+def _interp_mode(th: int, tw: int) -> str:
     """Resolve the LUT-interpolation strategy: 'gather' or 'matmul'.
 
-    ``WATERNET_CLAHE_INTERP`` forces a mode; auto picks the one-hot matmul
-    on TPU (gathers serialize on TPU; a one-hot bf16 matmul rides the MXU)
-    when the tile size is even (the half-tile cell decomposition needs it)
-    and the one-hot operand stays under ``_MATMUL_ONEHOT_CAP_BYTES``,
-    else the gather path.
+    ``WATERNET_CLAHE_INTERP`` forces a mode (matmul still falls back per
+    shape when the cell decomposition is impossible — see clahe()). Auto
+    picks the one-hot matmul on TPU (gathers serialize on TPU; a one-hot
+    bf16 matmul rides the MXU). Memory is bounded either way: the matmul
+    chunks itself under ``_MATMUL_ONEHOT_CAP_BYTES``, and odd tile sizes
+    degrade the cells to single rows/columns (more, smaller matmuls) —
+    still MXU-shaped, so auto enables them too; `tools/ab_bench.py`
+    measures whether that holds up against gather per config.
     """
-    if th % 2 or tw % 2:
-        return "gather"  # odd tiles can't split into half-tile cells
     import os
 
     forced = os.environ.get("WATERNET_CLAHE_INTERP", "").strip().lower()
     if forced in ("gather", "matmul"):
         return forced
-    if hp * wp * 256 * 2 > _MATMUL_ONEHOT_CAP_BYTES:
-        return "gather"
     return "matmul" if jax.default_backend() == "tpu" else "gather"
 
 
@@ -176,72 +177,127 @@ def _tile_hist(tiles, use_pallas):
 
 
 def _cell_tile_indices(n_pix, tile, n_tiles):
-    """Per-half-tile-cell (lo, hi) tile indices, or None.
+    """-> (cell_extent, (lo, hi)) per-cell tile indices along one axis.
 
     Reproduces the runtime grid arithmetic exactly — float32 multiply by the
     float32 reciprocal, minus 0.5, floor — in numpy at trace time (IEEE f32
-    elementwise ops are bit-identical between numpy and XLA), then checks
-    that every pixel of each half-tile cell landed on the same tile pair.
-    A None return means f32 rounding moved a boundary into a cell interior
-    for this shape, and the caller must use the per-pixel gather path to
-    stay bit-exact with OpenCV."""
-    half = tile // 2
+    elementwise ops are bit-identical between numpy and XLA). Cells are
+    half-tile extents when the tile size is even AND every pixel of each
+    cell landed on the same tile pair under f32 rounding; otherwise single
+    pixels (always valid — each pixel trivially agrees with itself). The
+    caller batches one matmul per cell, so smaller cells mean more, smaller
+    matmuls, never wrong answers."""
     inv = np.float32(1.0) / np.float32(tile)
     coords = np.arange(n_pix, dtype=np.float32) * inv - np.float32(0.5)
-    f = np.floor(coords).astype(np.int64).reshape(-1, half)
-    if not (f == f[:, :1]).all():
-        return None
-    lo = f[:, 0]
+    fl = np.floor(coords).astype(np.int64)
+    cell = tile // 2 if tile % 2 == 0 else 1
+    if cell > 1:
+        f = fl.reshape(-1, cell)
+        if (f == f[:, :1]).all():
+            fl = f[:, 0]
+        else:
+            cell = 1  # f32 rounding split a cell; degrade to single pixels
+    lo = fl
     hi = np.minimum(lo + 1, n_tiles - 1)
     lo = np.maximum(lo, 0)
-    return lo, hi
+    return cell, (lo, hi)
 
 
-def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, th, tw):
-    """The four quadrant LUT lookups as one batched one-hot matmul.
+def _fit_cell_rows(cell_h, cells_y, cell_w, wp):
+    """Subdivide cell height until one cell-row's operands fit the cap.
 
-    The (padded) image splits into (2*ty, 2*tx) half-tile cells; every pixel
-    in a cell interpolates between the SAME four tile LUTs (the cell index
-    determines floor(y/th - 0.5) etc.). Stacking those four 256-entry LUTs
-    per cell gives a (cells, 256, 4) operand, and the pixel values become a
-    (cells, pix, 256) one-hot; a bf16 batched matmul then performs all four
-    lookups per pixel on the MXU. Exact: each output element is a single
-    1.0 * lut product (LUT values are integers <= 255, exactly representable
-    in bf16), so the result is bit-identical to the gather path.
+    Every pixel of a cell shares its tile pair, so any divisor of cell_h
+    still yields constant cells (entries repeat). Returns the adjusted
+    (cell_h, cells_y), or None when even single-pixel rows can't fit —
+    per-row table bytes depend only on ncx, so that's the ncx*2048 > cap
+    degenerate case (both tiles odd at extreme widths)."""
+    ncx = wp // cell_w
+    tables_row = ncx * 256 * 4 * 2
+
+    def row_bytes(ch):
+        return max(ncx * ch * cell_w * 256 * 2, tables_row)
+
+    d = cell_h
+    while d > 1 and row_bytes(d) > _MATMUL_ONEHOT_CAP_BYTES:
+        d = max(k for k in range(1, d) if d % k == 0)
+    if row_bytes(d) > _MATMUL_ONEHOT_CAP_BYTES:
+        return None
+    if d != cell_h:
+        lo, hi = cells_y
+        cells_y = (np.repeat(lo, cell_h // d), np.repeat(hi, cell_h // d))
+    return d, cells_y
+
+
+def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, cell_h, cell_w):
+    """The four quadrant LUT lookups as batched one-hot matmuls.
+
+    The (padded) image splits into (ncy, ncx) cells of (cell_h, cell_w)
+    pixels — half-tile extents when the tile size is even, single rows/
+    columns otherwise; every pixel in a cell interpolates between the SAME
+    four tile LUTs (the cell index determines floor(y/th - 0.5) etc.).
+    Stacking those four 256-entry LUTs per cell gives a (cells, 256, 4)
+    operand, and the pixel values become a (cells, pix, 256) one-hot; a
+    bf16 batched matmul then performs all four lookups per pixel on the
+    MXU. Exact: each output element is a single 1.0 * lut product (LUT
+    values are integers <= 255, exactly representable in bf16), so the
+    result is bit-identical to the gather path. Cell rows are processed in
+    lax.scan groups sized so the one-hot (and the per-group tables) stay
+    under ``_MATMUL_ONEHOT_CAP_BYTES`` at any frame size.
 
     Returns four (hp, wp) float32 planes (quadrants 11, 12, 21, 22).
     """
     hp, wp = v_pad.shape
-    th2, tw2 = th // 2, tw // 2
     y1, y2 = cells_y
     x1, x2 = cells_x
     ncy, ncx = len(y1), len(x1)
+    x1j, x2j = jnp.asarray(x1), jnp.asarray(x2)
 
-    def tab(yi, xi):  # (ncy, ncx, 256)
-        return luts[yi[:, None], xi[None, :], :]
+    # Largest divisor of ncy for which BOTH per-group operands (one-hot and
+    # LUT tables) fit the cap.
+    per_row = max(ncx * cell_h * cell_w * 256 * 2, ncx * 256 * 4 * 2)
+    budget = max(_MATMUL_ONEHOT_CAP_BYTES // per_row, 1)
+    g = max(d for d in range(1, ncy + 1) if ncy % d == 0 and d <= budget)
+    n_groups = ncy // g
 
-    tables = jnp.stack(
-        [tab(y1, x1), tab(y1, x2), tab(y2, x1), tab(y2, x2)], axis=-1
-    )  # (ncy, ncx, 256, 4)
-    tables = tables.reshape(ncy * ncx, 256, 4).astype(jnp.bfloat16)
+    def group_planes(vg, y1g, y2g):
+        # vg: (g*cell_h, wp); y1g/y2g: (g,) tile rows for this cell-row group
+        def tab(yi, xi):  # (g, ncx, 256)
+            return luts[yi[:, None], xi[None, :], :]
 
-    cells = (
-        v_pad.reshape(ncy, th2, ncx, tw2)
-        .transpose(0, 2, 1, 3)
-        .reshape(ncy * ncx, th2 * tw2)
-    )
-    onehot = jax.nn.one_hot(cells, 256, dtype=jnp.bfloat16)
-    looked = jax.lax.dot_general(
-        onehot,
-        tables,
-        (((2,), (1,)), ((0,), (0,))),  # contract over the 256 bins, batch cells
-        preferred_element_type=jnp.float32,
-    )  # (cells, pix, 4)
-    planes = (
-        looked.reshape(ncy, ncx, th2, tw2, 4)
-        .transpose(4, 0, 2, 1, 3)
-        .reshape(4, hp, wp)
-    )
+        tables = jnp.stack(
+            [tab(y1g, x1j), tab(y1g, x2j), tab(y2g, x1j), tab(y2g, x2j)],
+            axis=-1,
+        ).reshape(g * ncx, 256, 4).astype(jnp.bfloat16)
+        cells = (
+            vg.reshape(g, cell_h, ncx, cell_w)
+            .transpose(0, 2, 1, 3)
+            .reshape(g * ncx, cell_h * cell_w)
+        )
+        onehot = jax.nn.one_hot(cells, 256, dtype=jnp.bfloat16)
+        looked = jax.lax.dot_general(
+            onehot,
+            tables,
+            (((2,), (1,)), ((0,), (0,))),  # contract the 256 bins, batch cells
+            preferred_element_type=jnp.float32,
+        )  # (cells, pix, 4)
+        return (
+            looked.reshape(g, ncx, cell_h, cell_w, 4)
+            .transpose(4, 0, 2, 1, 3)
+            .reshape(4, g * cell_h, wp)
+        )
+
+    if n_groups == 1:
+        planes = group_planes(v_pad, jnp.asarray(y1), jnp.asarray(y2))
+    else:
+        vg = v_pad.reshape(n_groups, g * cell_h, wp)
+        y1g = jnp.asarray(y1).reshape(n_groups, g)
+        y2g = jnp.asarray(y2).reshape(n_groups, g)
+
+        def body(_, xs):
+            return None, group_planes(*xs)
+
+        _, out = jax.lax.scan(body, None, (vg, y1g, y2g))
+        planes = out.transpose(1, 0, 2, 3).reshape(4, hp, wp)
     return planes[0], planes[1], planes[2], planes[3]
 
 
@@ -302,13 +358,17 @@ def clahe(
     # OpenCV computes tile coords as x * (1/tile_size) with a float32
     # reciprocal (not a division); matching that exactly is what makes the
     # rounding ties land identically (verified bit-exact vs cv2).
-    mode = _interp_mode(th, tw, hp, wp)
-    cells_y = cells_x = None
+    mode = _interp_mode(th, tw)
+    cells = None
     if mode == "matmul":
-        cells_y = _cell_tile_indices(hp, th, ty)
-        cells_x = _cell_tile_indices(wp, tw, tx)
-        if cells_y is None or cells_x is None:
-            mode = "gather"  # f32 rounding split a cell; stay exact
+        cell_h, cells_y = _cell_tile_indices(hp, th, ty)
+        cell_w, cells_x = _cell_tile_indices(wp, tw, tx)
+        fitted = _fit_cell_rows(cell_h, cells_y, cell_w, wp)
+        if fitted is None:
+            mode = "gather"  # even 1-px cell rows can't fit the cap
+        else:
+            cell_h, cells_y = fitted
+            cells = (cells_y, cells_x, cell_h, cell_w)
     gh, gw = (h, w) if mode == "gather" else (hp, wp)
     inv_th = np.float32(1.0) / np.float32(th)
     inv_tw = np.float32(1.0) / np.float32(tw)
@@ -323,7 +383,10 @@ def clahe(
         # All four lookups as one MXU one-hot matmul over half-tile cells
         # (bit-identical values; see _lut_planes_matmul), computed on the
         # padded grid and cropped after the blend.
-        p11, p12, p21, p22 = _lut_planes_matmul(luts, x, cells_y, cells_x, th, tw)
+        cells_y, cells_x, cell_h, cell_w = cells
+        p11, p12, p21, p22 = _lut_planes_matmul(
+            luts, x, cells_y, cells_x, cell_h, cell_w
+        )
         res = (p11 * (1.0 - xa) + p12 * xa) * (1.0 - ya) + (
             p21 * (1.0 - xa) + p22 * xa
         ) * ya
