@@ -119,7 +119,23 @@ def test_clahe_matmul_hist_chunked_bitexact(rng, monkeypatch):
     np.testing.assert_array_equal(got, want.astype(np.float32))
 
 
-def test_wb_device_histogram_quantiles_fuzz():
+def test_transform_batch_matmul_modes_match_default(rng, monkeypatch):
+    """vmap+jit composition of the MXU CLAHE modes — the exact form the TPU
+    train step runs — must equal the default CPU modes batchwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.ops import transform_batch
+
+    batch = jnp.asarray(
+        rng.integers(0, 256, (3, 64, 48, 3), dtype=np.uint8), jnp.float32
+    )
+    base = [np.asarray(t) for t in jax.jit(transform_batch)(batch)]
+    monkeypatch.setenv("WATERNET_CLAHE_INTERP", "matmul")
+    monkeypatch.setenv("WATERNET_CLAHE_HIST", "matmul")
+    got = [np.asarray(t) for t in jax.jit(transform_batch)(batch)]
+    for b, g, name in zip(base, got, ("wb", "gc", "he")):
+        np.testing.assert_array_equal(b, g, err_msg=name)
     """The histogram-CDF order statistics must track the host float64
     quantiles across random and degenerate inputs (all-black channel,
     constant channel, tiny images). Own RNG: the shared fixture's stream
