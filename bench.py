@@ -127,6 +127,15 @@ def bench_video(hw=(1080, 1920), batch=4, steps=12):
     )
 
 
+def _clahe_modes():
+    """(hist_mode, interp_mode) the benchmark workload resolves to."""
+    from waternet_tpu.ops.clahe import TILE_GRID, _hist_mode, _interp_mode
+
+    ty, tx = TILE_GRID
+    th, tw = HW // ty, HW // tx  # benchmark HW divides the grid
+    return _hist_mode(None), _interp_mode(th, tw)
+
+
 def _probe_accelerator(timeout_s: int = 180):
     """Check device init in a subprocess so a dead accelerator tunnel can't
     hang the benchmark forever (the PJRT client retries in a sleep loop with
@@ -268,6 +277,9 @@ def main():
         "batch": BATCH,
         "hw": HW,
         "precision": PRECISION,
+        # Which classical-op strategies this number was measured with.
+        "clahe_hist": _clahe_modes()[0],
+        "clahe_interp": _clahe_modes()[1],
     }
     print(json.dumps(line))
 

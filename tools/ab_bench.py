@@ -113,6 +113,19 @@ def main():
                 {}, ("--config", "video", "--batch-size", str(b))
             )
             Path(args.out).write_text(json.dumps(report, indent=2))
+        # 1080p CLAHE strategy A/B at the best-guess batch: the odd 135-row
+        # tiles are exactly where the generalized matmul interp must prove
+        # itself against gather (and scatter vs chunked-matmul histograms).
+        for name, env in (
+            ("video_interp_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
+            ("video_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
+            ("video_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter"}),
+        ):
+            print(f"[ab_bench] {name}", file=sys.stderr)
+            report["video"][name] = run_bench(
+                env, ("--config", "video", "--batch-size", "4")
+            )
+            Path(args.out).write_text(json.dumps(report, indent=2))
     print(json.dumps(report, indent=2))
 
 
