@@ -13,9 +13,12 @@ the loader here — the dependency list is jax, not torch.
 dependencies = ["jax", "flax", "numpy", "cv2"]
 
 
-def waternet(pretrained: bool = True, weights=None, device=None):
+def waternet(pretrained: bool = True, weights=None, device=None, download=False):
     """Build WaterNet. ``device`` is accepted for signature compatibility
-    with the reference and ignored (jax manages placement)."""
+    with the reference and ignored (jax manages placement). ``download=True``
+    opts in to the reference's hash-verified pretrained fetch when no local
+    weights resolve (the reference downloads implicitly; here egress is
+    opt-in)."""
     import sys
     from pathlib import Path
 
@@ -32,4 +35,4 @@ def waternet(pretrained: bool = True, weights=None, device=None):
         if added and repo in sys.path:
             sys.path.remove(repo)
 
-    return _waternet(pretrained=pretrained, weights=weights)
+    return _waternet(pretrained=pretrained, weights=weights, download=download)
