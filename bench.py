@@ -277,10 +277,9 @@ def main():
         "batch": BATCH,
         "hw": HW,
         "precision": PRECISION,
-        # Which classical-op strategies this number was measured with.
-        "clahe_hist": _clahe_modes()[0],
-        "clahe_interp": _clahe_modes()[1],
     }
+    # Which classical-op strategies this number was measured with.
+    line["clahe_hist"], line["clahe_interp"] = _clahe_modes()
     print(json.dumps(line))
 
 

@@ -38,6 +38,8 @@ bounded by tolerance tests; the host path remains the parity path.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -91,8 +93,6 @@ def _interp_mode(th: int, tw: int) -> str:
     still MXU-shaped, so auto enables them too; `tools/ab_bench.py`
     measures whether that holds up against gather per config.
     """
-    import os
-
     forced = os.environ.get("WATERNET_CLAHE_INTERP", "").strip().lower()
     if forced in ("gather", "matmul"):
         return forced
@@ -109,8 +109,6 @@ def _hist_mode(use_pallas) -> str:
     one-hot cap, so it handles any frame size. CPU keeps scatter (fast
     there).
     """
-    import os
-
     # Explicit argument wins over the env override (an exported
     # WATERNET_CLAHE_HIST must not silently reroute callers — or tests —
     # that pin a path via use_pallas=...).
@@ -359,7 +357,6 @@ def clahe(
     # reciprocal (not a division); matching that exactly is what makes the
     # rounding ties land identically (verified bit-exact vs cv2).
     mode = _interp_mode(th, tw)
-    cells = None
     if mode == "matmul":
         cell_h, cells_y = _cell_tile_indices(hp, th, ty)
         cell_w, cells_x = _cell_tile_indices(wp, tw, tx)
@@ -368,7 +365,6 @@ def clahe(
             mode = "gather"  # even 1-px cell rows can't fit the cap
         else:
             cell_h, cells_y = fitted
-            cells = (cells_y, cells_x, cell_h, cell_w)
     gh, gw = (h, w) if mode == "gather" else (hp, wp)
     inv_th = np.float32(1.0) / np.float32(th)
     inv_tw = np.float32(1.0) / np.float32(tw)
@@ -380,10 +376,9 @@ def clahe(
     xa = (xx - x1.astype(jnp.float32))[None, :]
 
     if mode == "matmul":
-        # All four lookups as one MXU one-hot matmul over half-tile cells
-        # (bit-identical values; see _lut_planes_matmul), computed on the
-        # padded grid and cropped after the blend.
-        cells_y, cells_x, cell_h, cell_w = cells
+        # All four lookups as batched MXU one-hot matmuls over the cell
+        # decomposition (bit-identical values; see _lut_planes_matmul),
+        # computed on the padded grid and cropped after the blend.
         p11, p12, p21, p22 = _lut_planes_matmul(
             luts, x, cells_y, cells_x, cell_h, cell_w
         )
