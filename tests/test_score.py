@@ -1,0 +1,71 @@
+"""score.py CLI tests: paired scoring round-trip and the no-reference
+(Challenging-60 analog) mode the reference cannot evaluate at all."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def weights_file(tmp_path_factory):
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.models import WaterNet
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    params = WaterNet().init(jax.random.PRNGKey(2), x, x, x, x)
+    path = tmp_path_factory.mktemp("w") / "w.npz"
+    save_weights(params, path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def uieb_root(tmp_path_factory):
+    cv2 = pytest.importorskip("cv2")
+
+    root = tmp_path_factory.mktemp("uieb")
+    rng = np.random.default_rng(4)
+    for sub in ("raw-890", "reference-890"):
+        (root / sub).mkdir()
+        for i in range(6):
+            cv2.imwrite(
+                str(root / sub / f"{i:03d}.png"),
+                rng.integers(0, 256, (40, 52, 3), dtype=np.uint8),
+            )
+    return root
+
+
+def test_score_paired_roundtrip(weights_file, uieb_root, tmp_path):
+    import score as cli
+
+    out = tmp_path / "m.json"
+    cli.main([
+        "--weights", str(weights_file), "--data-root", str(uieb_root),
+        "--val-size", "2", "--height", "32", "--width", "32",
+        "--batch-size", "4", "--json-out", str(out),
+    ])
+    metrics = json.loads(out.read_text())
+    assert set(metrics) >= {"mse", "ssim", "psnr"}
+    assert metrics["mse"] >= 0 and -1 <= metrics["ssim"] <= 1
+
+
+def test_score_nr_mode(weights_file, uieb_root, tmp_path):
+    """--raw-dir scores unpaired images with UCIQE/UIQM before/after —
+    the capability the reference lacks for UIEB's Challenging-60 split."""
+    import score as cli
+
+    out = tmp_path / "nr.json"
+    cli.main([
+        "--weights", str(weights_file), "--raw-dir", str(uieb_root / "raw-890"),
+        "--height", "32", "--width", "32", "--batch-size", "4",
+        "--json-out", str(out),
+    ])
+    metrics = json.loads(out.read_text())
+    assert set(metrics) >= {
+        "uciqe_raw", "uiqm_raw", "uciqe_enhanced", "uiqm_enhanced", "images",
+    }
+    assert metrics["images"] == 6
+    assert all(np.isfinite(v) for v in metrics.values())
