@@ -1,6 +1,9 @@
 """Worker process for the multi-host training test (see test_multihost.py).
 
-Run as: python tests/multihost_worker.py <process_id> <num_processes> <port>
+Run as: python tests/multihost_worker.py <process_id> <num_processes> <port> [mode]
+mode: "dp" (default; 4x1 data-parallel mesh) or "dpsp" (2x2 data x spatial
+mesh with the VGG perceptual term ON — the H-gather before the VGG branch
+then crosses the process boundary, the riskiest cross-host collective).
 Prints the epoch loss; both ranks must agree (the batch is globally sharded
 and gradients all-reduce across processes).
 """
@@ -12,6 +15,7 @@ from pathlib import Path
 proc_id = int(sys.argv[1])
 num_procs = int(sys.argv[2])
 port = sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -36,11 +40,21 @@ import numpy as np  # noqa: E402
 
 from waternet_tpu.training.trainer import TrainConfig, TrainingEngine  # noqa: E402
 
-cfg = TrainConfig(
-    batch_size=4, im_height=32, im_width=32,
-    precision="fp32", perceptual_weight=0.0, augment=False,
-)
-engine = TrainingEngine(cfg)
+if mode == "dpsp":
+    from waternet_tpu.parallel.mesh import make_mesh
+
+    cfg = TrainConfig(
+        batch_size=4, im_height=32, im_width=32,
+        precision="fp32", perceptual_weight=0.05, augment=False,
+        spatial_shards=2,
+    )
+    engine = TrainingEngine(cfg, mesh=make_mesh(n_data=2, n_spatial=2))
+else:
+    cfg = TrainConfig(
+        batch_size=4, im_height=32, im_width=32,
+        precision="fp32", perceptual_weight=0.0, augment=False,
+    )
+    engine = TrainingEngine(cfg)
 rng = np.random.default_rng(0)
 raw = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
 ref = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)

@@ -32,13 +32,17 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_training_agrees():
+@pytest.mark.parametrize("mode", ["dp", "dpsp"])
+def test_two_process_training_agrees(mode):
+    """dp: pure data-parallel gradient all-reduce across processes.
+    dpsp: 2x2 (data x spatial) mesh with the perceptual term ON — the VGG
+    branch's H-gather collective crosses the process boundary too."""
     worker = Path(__file__).parent / "multihost_worker.py"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     port = str(_free_port())
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), "2", port],
+            [sys.executable, str(worker), str(i), "2", port, mode],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
         )
         for i in range(2)
