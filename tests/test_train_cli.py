@@ -1,0 +1,77 @@
+"""train.py CLI tests: artifact contract (CSVs, config.json, summary.json,
+checkpoints), the --epochs 0 edge, and explicit resume."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+ARGS = [
+    "--synthetic", "8", "--batch-size", "4", "--height", "32", "--width", "32",
+    "--no-perceptual", "--precision", "fp32",
+]
+
+
+@pytest.fixture()
+def run_dir(tmp_path, monkeypatch):
+    d = tmp_path / "run"
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir", lambda base, name=None: d
+    )
+    return d
+
+
+def test_train_cli_artifact_contract(run_dir):
+    import train as cli
+
+    cli.main(ARGS + ["--epochs", "2"])
+    assert (run_dir / "last.npz").exists()
+    assert (run_dir / "state").is_dir()
+    cfg = json.loads((run_dir / "config.json").read_text())
+    assert cfg["epochs"] == 2 and cfg["batch_size"] == 4
+    summary = json.loads((run_dir / "summary.json").read_text())
+    assert summary["epochs"] == 2
+    assert summary["train_images_per_sec_mean"] > 0
+    train_csv = np.loadtxt(
+        run_dir / "metrics-train.csv", delimiter=",", skiprows=1
+    )
+    assert train_csv.shape[0] == 2  # one row per epoch
+    header = (run_dir / "metrics-train.csv").read_text().splitlines()[0]
+    assert header.split(",")[:2] == ["mse", "ssim"]
+
+
+def test_train_cli_epochs_zero_exits_cleanly(run_dir):
+    import train as cli
+
+    cli.main(ARGS + ["--epochs", "0"])  # must not raise (round-1 crash)
+    summary = json.loads((run_dir / "summary.json").read_text())
+    assert summary["epochs"] == 0
+    assert "train_images_per_sec_mean" not in summary
+
+
+def test_train_cli_resume_continues_step(tmp_path, monkeypatch):
+    import train as cli
+
+    d1 = tmp_path / "r1"
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir", lambda base, name=None: d1
+    )
+    cli.main(ARGS + ["--epochs", "1"])
+
+    d2 = tmp_path / "r2"
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir", lambda base, name=None: d2
+    )
+    cli.main(ARGS + ["--epochs", "1", "--resume", str(d1 / "state")])
+
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    cfg = TrainConfig(
+        batch_size=4, im_height=32, im_width=32,
+        precision="fp32", perceptual_weight=0.0,
+    )
+    eng = TrainingEngine(cfg)
+    eng.restore(d2 / "state")
+    # 8 images / batch 4 = 2 steps per epoch; resumed run ends at step 4.
+    assert int(eng.state.step) == 4
