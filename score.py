@@ -59,6 +59,19 @@ def parse_args(argv=None):
                    help="Reproduce the reference's perceptual_loss accumulation bug")
     p.add_argument("--json-out", type=str, help="Also write metrics to this JSON file")
     p.add_argument(
+        "--epochs", type=int, default=None,
+        help="(Compat) accepted and ignored: the reference scorer inherited "
+             "this flag from train.py and never uses it (`score.py:99-100`)",
+    )
+    p.add_argument(
+        "--seed", type=int, default=None,
+        help="(Compat) in the reference, a non-None seed reseeds torch's "
+             "global RNG before random_split, silently changing WHICH 90 "
+             "images count as val (`score.py:132-133,141`); this scorer "
+             "always evaluates the canonical seed-0 split and warns if a "
+             "different seed is requested",
+    )
+    p.add_argument(
         "--raw-dir", type=str,
         help="Score a directory of raw images with NO references (e.g. UIEB "
         "challenging-60) using no-reference metrics (UCIQE/UIQM), before and "
@@ -134,6 +147,17 @@ def main(argv=None):
     from waternet_tpu.utils.platform import enable_compile_cache
 
     enable_compile_cache()
+
+    if args.seed not in (None, 0):
+        import warnings
+
+        warnings.warn(
+            f"--seed {args.seed} is accepted for reference CLI compatibility "
+            "only: this scorer always evaluates the canonical seed-0 split "
+            "(the reference would have moved images between train and val).",
+            RuntimeWarning,
+            stacklevel=1,
+        )
 
     if args.raw_dir:
         metrics = score_no_reference(args)
