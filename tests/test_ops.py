@@ -119,6 +119,35 @@ def test_clahe_matmul_hist_chunked_bitexact(rng, monkeypatch):
     np.testing.assert_array_equal(got, want.astype(np.float32))
 
 
+def test_clahe_matmul_interp_grid_fuzz(rng, monkeypatch):
+    """The cell decomposition must stay cv2-bit-exact for non-default tile
+    grids too (non-square, coarse, fine) — the generalized machinery's
+    cell/subdivision logic is grid-dependent even though the reference only
+    ever uses (8, 8)."""
+    import cv2
+
+    from waternet_tpu.ops.clahe import clahe
+
+    monkeypatch.setenv("WATERNET_CLAHE_INTERP", "matmul")
+    monkeypatch.setenv("WATERNET_CLAHE_HIST", "matmul")
+    # cv2's tileGridSize is a cv::Size, i.e. (tilesX, tilesY); our
+    # tile_grid is (ty, tx) — transposed.
+    for (ty, tx), (h, w) in [
+        ((4, 4), (90, 61)),
+        ((16, 16), (128, 128)),
+        ((4, 8), (73, 112)),
+        ((8, 2), (171, 31)),
+    ]:
+        cl = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(tx, ty))
+        lum = rng.integers(0, 256, size=(h, w), dtype=np.uint8)
+        want = cl.apply(lum)
+        got = np.asarray(clahe(lum.astype(np.float32), tile_grid=(ty, tx)))
+        np.testing.assert_array_equal(
+            got, want.astype(np.float32),
+            err_msg=f"grid ({ty},{tx}) shape {(h, w)}",
+        )
+
+
 def test_transform_batch_matmul_modes_match_default(rng, monkeypatch):
     """vmap+jit composition of the MXU CLAHE modes — the exact form the TPU
     train step runs — must equal the default CPU modes batchwise."""
@@ -197,7 +226,10 @@ def test_clahe_core_bitexact_fuzz_shapes(rng):
     from waternet_tpu.ops.clahe import clahe
 
     cl = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8))
-    for h, w in [(8, 8), (17, 31), (56, 56), (100, 36), (64, 200), (131, 97)]:
+    # (73,112)/(112,73)/(64,100) pad exactly ONE axis: cv2 then pads the
+    # divisible axis by a FULL tile-count too (round-2 parity bug fix).
+    for h, w in [(8, 8), (17, 31), (56, 56), (100, 36), (64, 200),
+                 (131, 97), (73, 112), (112, 73), (64, 100)]:
         lum = rng.integers(0, 256, size=(h, w), dtype=np.uint8)
         want = cl.apply(lum)
         got = np.asarray(clahe(lum.astype(np.float32)))

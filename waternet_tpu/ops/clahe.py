@@ -309,13 +309,25 @@ def clahe(
 
     Args:
         l_chan: (H, W) uint8-valued array (any real dtype).
+        tile_grid: (ty, tx) tile counts along (H, W) — note cv2's
+            ``tileGridSize`` is a cv::Size, i.e. the transposed
+            (tilesX, tilesY); equivalence is ``tile_grid=(gy, gx)``.
     Returns:
         (H, W) float32 holding exact uint8 values.
     """
     h, w = l_chan.shape
     ty, tx = tile_grid
-    pad_h = (-h) % ty
-    pad_w = (-w) % tx
+    # OpenCV quirk, reproduced exactly: when EITHER axis is non-divisible,
+    # copyMakeBorder pads BOTH by ``tiles - (size % tiles)`` — which is a
+    # FULL extra tile-count of pixels (one per tile) on an axis that was
+    # already divisible (clahe.cpp pads with tilesX_ - (width % tilesX_),
+    # not modulo). Caught by single-axis-padding fuzz; padding each axis
+    # independently gives different tile sizes and diverges everywhere.
+    if h % ty == 0 and w % tx == 0:
+        pad_h = pad_w = 0
+    else:
+        pad_h = ty - (h % ty)
+        pad_w = tx - (w % tx)
     x = l_chan.astype(jnp.int32)
     if pad_h or pad_w:
         x = jnp.pad(x, ((0, pad_h), (0, pad_w)), mode="reflect")
