@@ -313,27 +313,37 @@ def test_clahe_matmul_interp_chunked_bitexact(rng, monkeypatch):
     np.testing.assert_array_equal(got2, want2.astype(np.float32))
 
 
-def test_lab_conversion_close_to_cv2(sample_rgb):
+def test_rgb_to_lab_u8_bitexact_vs_cv2(sample_rgb, rng):
+    """The forward LAB conversion replicates cv2's uint8 fixed-point path
+    exactly (verified exhaustively over all 256^3 inputs during round 2;
+    here a broad random + boundary sample is asserted EQUAL, not close)."""
     import cv2
 
     from waternet_tpu.ops.color import rgb_to_lab_u8
 
-    want = cv2.cvtColor(sample_rgb, cv2.COLOR_RGB2LAB).astype(np.float32)
-    got = np.asarray(rgb_to_lab_u8(sample_rgb))
-    # cv2's uint8 path is fixed-point; float formula lands within 2 levels.
-    assert np.abs(got - want).max() <= 2.0
+    big = rng.integers(0, 256, (512, 512, 3), dtype=np.uint8)
+    edges = np.array(
+        [[[0, 0, 0], [255, 255, 255], [255, 0, 0], [0, 255, 0]],
+         [[0, 0, 255], [1, 1, 1], [254, 254, 254], [128, 128, 128]]],
+        dtype=np.uint8,
+    )
+    for img in (sample_rgb, big, edges):
+        want = cv2.cvtColor(img, cv2.COLOR_RGB2LAB).astype(np.float32)
+        got = np.asarray(rgb_to_lab_u8(img))
+        np.testing.assert_array_equal(got, want)
 
 
 def test_histeq_device_close_to_host(sample_rgb):
-    """End-to-end device histeq is approximate: CLAHE at clipLimit=0.1 is a
-    rank-equalizer of distinct gray levels, so the ~12% of pixels whose L
-    differs by 1 (float vs fixed-point LAB) shift LUT ranks. Documented
-    tolerance, not parity — the host path is the parity path."""
+    """End-to-end device histeq: the forward LAB and the CLAHE core are
+    bit-exact vs cv2, so the only remaining divergence is the float
+    LAB->RGB inverse — at most a few levels on a few percent of pixels
+    (exhaustive inverse bound: <=3 levels, >1 level on <0.003% of the LAB
+    cube). The host path remains the strict parity path."""
     host = histeq_np(sample_rgb).astype(np.float32)
     dev = np.asarray(histeq(sample_rgb))
     diff = np.abs(dev - host)
-    assert diff.mean() < 5.0, diff.mean()
-    assert (diff <= 2).mean() > 0.75
+    assert diff.max() <= 3.0, diff.max()
+    assert (diff > 0).mean() < 0.10
 
 
 # ---------------------------------------------------------------------------

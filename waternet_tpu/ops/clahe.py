@@ -28,12 +28,12 @@ pipeline given the same L input:
 6. Per-pixel bilinear interpolation between the 4 surrounding tile LUTs with
    OpenCV's ``(x / tile_w) - 0.5`` tile coordinates and edge clamping.
 
-Differences vs cv2 can only come from the L channel itself (float vs
-fixed-point LAB conversion, see :mod:`waternet_tpu.ops.color`): given cv2's
-own L input, :func:`clahe` is bit-exact vs ``cv2.CLAHE.apply`` (tested).
-End-to-end ``histeq`` differs from the host path on the ~12% of pixels whose
-L value lands one level off, which the rank-equalizing LUT amplifies —
-bounded by tolerance tests; the host path remains the parity path.
+The L channel fed to CLAHE is bit-exact vs cv2 too (the forward LAB
+conversion replicates OpenCV's uint8 fixed-point pipeline — see
+:mod:`waternet_tpu.ops.color`), so end-to-end ``histeq`` differs from the
+host path only through the float LAB->RGB inverse: at most a few levels on
+a few percent of pixels (bounded by tests); the host path remains the
+strict parity path.
 """
 
 from __future__ import annotations
@@ -419,8 +419,9 @@ def clahe(
 def histeq(rgb: jnp.ndarray) -> jnp.ndarray:
     """Device-path `histeq`: (H, W, 3) uint8-valued RGB -> float32 uint8 values.
 
-    RGB -> LAB (float approximation of cv2), OpenCV-exact CLAHE on L,
-    LAB -> RGB. Jittable; vmap for batches.
+    RGB -> LAB (cv2's uint8 fixed-point path, bit-exact), OpenCV-exact
+    CLAHE on L, LAB -> RGB (float inverse — the only non-bit-exact stage).
+    Jittable; vmap for batches.
     """
     lab = rgb_to_lab_u8(rgb)
     el = clahe(lab[..., 0])
