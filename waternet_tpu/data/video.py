@@ -16,7 +16,14 @@ import numpy as np
 
 
 def _read_batch(cap, batch_size: int):
-    """Read up to batch_size frames; returns (bgr_frames, rgb_array|None)."""
+    """Read up to batch_size frames; returns (bgr_frames, rgb_array|None).
+
+    A short final batch is padded (last frame repeated) up to batch_size so
+    the device sees ONE shape for the whole video — a tail batch of a
+    different shape would trigger a second multi-second XLA compile right at
+    the end of every clip. ``bgr_frames`` keeps only the real frames; the
+    caller drops the padded outputs by its length.
+    """
     import cv2
 
     frames = []
@@ -28,6 +35,9 @@ def _read_batch(cap, batch_size: int):
     if not frames:
         return [], None
     rgb = np.stack([cv2.cvtColor(f, cv2.COLOR_BGR2RGB) for f in frames])
+    if len(frames) < batch_size:
+        pad = np.repeat(rgb[-1:], batch_size - len(frames), axis=0)
+        rgb = np.concatenate([rgb, pad], axis=0)
     return frames, rgb
 
 
