@@ -1,0 +1,78 @@
+"""Unit tests for bench.py's pure helpers (no accelerator, no heavy jit)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+class _FakeDev:
+    def __init__(self, kind, platform="tpu"):
+        self.device_kind = kind
+        self.platform = platform
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    monkeypatch.delenv("WATERNET_TPU_PEAK_TFLOPS", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_TPU_GEN", raising=False)
+    import bench
+
+    return bench
+
+
+def test_peak_tflops_kind_table(bench):
+    assert bench._peak_tflops(_FakeDev("TPU v5 lite")) == 197.0
+    assert bench._peak_tflops(_FakeDev("TPU v5p")) == 459.0
+    assert bench._peak_tflops(_FakeDev("TPU v4")) == 275.0
+    assert bench._peak_tflops(_FakeDev("TPU v6 lite")) == 918.0
+    assert bench._peak_tflops(_FakeDev("mystery accelerator")) is None
+
+
+def test_peak_tflops_env_and_gen_fallbacks(bench, monkeypatch):
+    monkeypatch.setenv("WATERNET_TPU_PEAK_TFLOPS", "123.5")
+    assert bench._peak_tflops(_FakeDev("anything")) == 123.5
+    monkeypatch.delenv("WATERNET_TPU_PEAK_TFLOPS")
+    # Opaque device_kind + env generation hint (the axon tunnel case).
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
+    assert bench._peak_tflops(_FakeDev("opaque")) == 197.0
+    # Never claim a TPU peak for the host CPU platform.
+    assert bench._peak_tflops(_FakeDev("cpu", platform="cpu")) is None
+
+
+def test_compiled_tflops_parsing(bench):
+    class C:
+        def __init__(self, ca):
+            self._ca = ca
+
+        def cost_analysis(self):
+            return self._ca
+
+    assert bench._compiled_tflops(C({"flops": 2.5e12})) == 2.5
+    assert bench._compiled_tflops(C([{"flops": 1e12}])) == 1.0  # older jax
+    assert bench._compiled_tflops(C({})) is None
+    assert bench._compiled_tflops(C({"flops": 0.0})) is None
+
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("backend says no")
+
+    assert bench._compiled_tflops(Broken()) is None
+
+
+def test_bench_rejects_bad_precision():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={"PATH": "/usr/bin:/bin", "WATERNET_BENCH_PRECISION": "bfloat16",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "WATERNET_BENCH_PRECISION" in proc.stderr + proc.stdout
