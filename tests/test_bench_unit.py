@@ -65,6 +65,61 @@ def test_compiled_tflops_parsing(bench):
     assert bench._compiled_tflops(Broken()) is None
 
 
+def test_relay_listening_skips_non_tunnel_platforms(bench, monkeypatch):
+    monkeypatch.delenv("WATERNET_TPU_PLATFORM", raising=False)
+    # Explicit CPU run never dials the tunnel -> check doesn't apply.
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("AXON_LOOPBACK_RELAY", "1")
+    assert bench._relay_listening() is None
+    # No tunnel env at all -> doesn't apply either.
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.delenv("AXON_LOOPBACK_RELAY", raising=False)
+    assert bench._relay_listening() is None
+
+
+@pytest.mark.skipif(
+    not Path("/proc/net/tcp").exists(), reason="needs Linux procfs"
+)
+def test_relay_listening_detects_real_listener(bench, monkeypatch):
+    """True while a localhost socket listens on the checked port, False
+    after it closes — verified against a real socket via /proc/net/tcp,
+    without _relay_listening ever connecting to it."""
+    import socket
+
+    monkeypatch.delenv("WATERNET_TPU_PLATFORM", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        port = s.getsockname()[1]
+        monkeypatch.setenv("WATERNET_RELAY_PORT", str(port))
+        assert bench._relay_listening() is True
+    finally:
+        s.close()
+    assert bench._relay_listening() is False
+
+
+@pytest.mark.skipif(
+    not Path("/proc/net/tcp").exists(), reason="needs Linux procfs"
+)
+def test_bench_parent_fails_fast_when_relay_down(monkeypatch):
+    """With an axon-style env and no relay listening, the parent prints the
+    contract JSON error line without ever touching a device."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "axon",
+             "WATERNET_RELAY_PORT": "1"},  # nothing listens on port 1
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["value"] == 0.0
+    assert "relay is not listening" in line["error"]
+
+
 def test_bench_rejects_bad_precision():
     proc = subprocess.run(
         [sys.executable, str(REPO / "bench.py")],

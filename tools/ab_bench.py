@@ -16,8 +16,12 @@ Usage::
 
     python tools/ab_bench.py [--out docs/bench_ab.json] [--skip-video]
 
-A fast accelerator probe runs first; if the tunnel is down the sweep aborts
-immediately instead of burning a 180s timeout per variant.
+A passive relay-liveness check (no connection made — connecting probes can
+themselves wedge the tunnel) runs first; a dead relay aborts the sweep
+immediately. A tunnel that is wedged while its relay still listens is only
+caught by the per-variant budgets: bench.py self-limits each run (600s
+train / 1800s video via WATERNET_BENCH_TIMEOUT), with a process-group-kill
+backstop here.
 """
 
 from __future__ import annotations
@@ -44,30 +48,52 @@ TRAIN_VARIANTS = [
 VIDEO_BATCHES = (2, 4, 8)
 
 
-def run_bench(extra_env, args=()):
+def run_bench(extra_env, args=(), timeout=None):
+    """One bench.py invocation in its own process group. bench.py owns the
+    real per-run budget (WATERNET_BENCH_TIMEOUT, 600s train / 1800s video);
+    this outer timeout is a strictly-larger backstop (computed from that
+    knob when set), and on expiry the WHOLE group is killed — bench.py
+    re-execs the benchmark as a grandchild, and an orphaned grandchild
+    would keep holding the single-client tunnel while the next variant
+    connects (the two-client wedge)."""
     env = dict(os.environ)
     env.update(extra_env)
+    if timeout is None:
+        sys.path.insert(0, str(REPO))
+        from bench import _env_int  # same parsing as bench.py itself
+
+        if "video" in args:
+            inner = _env_int("WATERNET_BENCH_VIDEO_TIMEOUT", 1800)
+        else:
+            inner = _env_int("WATERNET_BENCH_TIMEOUT", 600)
+        timeout = max(2100, inner + 300)
     t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "bench.py"), *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        cwd=REPO,
+        start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, str(REPO / "bench.py"), *args],
-            capture_output=True,
-            text=True,
-            env=env,
-            cwd=REPO,
-            timeout=1800,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        # Mid-sweep tunnel wedge (client retries forever, no error): record
-        # it against this variant and let the remaining variants try — the
-        # next bench.py's own probe will fail fast if the chip stays gone.
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
         return {
-            "error": "bench.py exceeded 1800s (tunnel wedged mid-run?)",
+            "error": f"bench.py exceeded {timeout}s (tunnel wedged mid-run?)",
             "wall_sec": round(time.perf_counter() - t0, 1),
         }
     wall = time.perf_counter() - t0
     line = None
-    for out_line in reversed(proc.stdout.strip().splitlines()):
+    for out_line in reversed(stdout.strip().splitlines()):
         try:
             line = json.loads(out_line)
             break
@@ -77,7 +103,7 @@ def run_bench(extra_env, args=()):
         line = {
             "error": "no JSON line",
             "rc": proc.returncode,
-            "stderr_tail": proc.stderr.strip().splitlines()[-3:],
+            "stderr_tail": stderr.strip().splitlines()[-3:],
         }
     line["wall_sec"] = round(wall, 1)
     return line
@@ -87,18 +113,18 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", default=str(REPO / "docs" / "bench_ab.json"))
     p.add_argument("--skip-video", action="store_true")
-    p.add_argument(
-        "--probe-timeout", type=int, default=90,
-        help="seconds to wait for device init before aborting the sweep",
-    )
     args = p.parse_args()
 
     sys.path.insert(0, str(REPO))
-    from bench import _probe_accelerator
+    from bench import _relay_listening
 
-    err = _probe_accelerator(timeout_s=args.probe_timeout)
-    if err is not None:
-        print(f"[ab_bench] aborting, accelerator unreachable: {err}", file=sys.stderr)
+    # Non-connecting liveness check: a connect+disconnect on the relay port
+    # can itself tear the tunnel down, so never dial it just to probe.
+    if _relay_listening() is False:
+        print(
+            "[ab_bench] aborting: accelerator tunnel relay is not listening",
+            file=sys.stderr,
+        )
         raise SystemExit(1)
 
     report = {"variants": {}, "video": {}}
