@@ -83,7 +83,8 @@ def _compiled_tflops(lowered_compiled) -> float | None:
 
 def bench_video(hw=(1080, 1920), batch=4, steps=12):
     """Secondary benchmark: full-res video-frame enhancement throughput
-    (BASELINE config 5), double-buffered like the video CLI path."""
+    (BASELINE config 5), double-buffered like the video CLI path.
+    Returns the JSON-line dict (the CLI prints it)."""
     import jax
 
     from waternet_tpu.data.synthetic import SyntheticPairs
@@ -102,7 +103,9 @@ def bench_video(hw=(1080, 1920), batch=4, steps=12):
     frames = np.stack(
         [SyntheticPairs(1, h, w, seed=i).load_pair(0)[0] for i in range(batch)]
     )
+    t0 = time.perf_counter()
     ten2arr(engine.enhance_async(frames))  # warmup/compile
+    compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     pending = engine.enhance_async(frames)
@@ -113,27 +116,119 @@ def bench_video(hw=(1080, 1920), batch=4, steps=12):
     ten2arr(pending)
     dt = time.perf_counter() - t0
     fps = batch * steps / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"video_{h}p_frames_per_sec_per_chip",
-                "value": round(fps, 2),
-                "unit": "frames/sec/chip",
-                "vs_baseline": None,
-                "batch": batch,
-                "frame_ms": round(dt / (batch * steps) * 1e3, 3),
-            }
-        )
+    return {
+        "metric": f"video_{h}p_frames_per_sec_per_chip",
+        "value": round(fps, 2),
+        "unit": "frames/sec/chip",
+        "vs_baseline": None,
+        "batch": batch,
+        "frame_ms": round(dt / (batch * steps) * 1e3, 3),
+        "compile_sec": round(compile_s, 1),
+    }
+
+
+def measure_train(
+    batch=None, hw=None, precision=None, warmup=None, steps=None
+):
+    """The headline measurement: one fused train step (on-device augment +
+    WB/GC/CLAHE + WaterNet + VGG fwd/bwd + Adam + metrics), AOT-compiled
+    once, steady-state timed. Returns the JSON-line dict (the CLI prints
+    it). Module-level env defaults apply when args are None so the CLI and
+    library callers (tools/tpu_session.py) share one code path."""
+    batch = BATCH if batch is None else batch
+    hw = HW if hw is None else hw
+    precision = PRECISION if precision is None else precision
+    warmup = max(0, WARMUP_STEPS if warmup is None else warmup)
+    steps = max(1, MEASURE_STEPS if steps is None else steps)
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    config = TrainConfig(
+        batch_size=batch, im_height=hw, im_width=hw, precision=precision
     )
+    engine = TrainingEngine(config)
 
+    data = SyntheticPairs(2 * batch, hw, hw, seed=0)
+    idx = np.arange(len(data))
+    batches = list(data.batches(idx, batch, shuffle=False, drop_remainder=True))
+    raw, ref = batches[0]
 
-def _clahe_modes():
-    """(hist_mode, interp_mode) the benchmark workload resolves to."""
+    import jax
+    import jax.numpy as jnp
+
+    raw_d = jnp.asarray(raw)
+    ref_d = jnp.asarray(ref)
+    rng = jax.random.PRNGKey(0)
+    n_real = jnp.asarray(batch, jnp.int32)
+
+    # AOT-compile the full fused step once (preprocess + WaterNet + VGG
+    # fwd/bwd + Adam + metrics); the same executable provides XLA's FLOP
+    # count AND runs the measured loop, so the step is compiled exactly once.
+    t0 = time.perf_counter()
+    compiled_step = engine.train_step.lower(
+        engine.state, raw_d, ref_d, rng, n_real
+    ).compile()
+    compile_s = time.perf_counter() - t0
+    step_tflop = _compiled_tflops(compiled_step)
+
+    state = engine.state
+    if warmup:
+        for i in range(warmup):
+            state, m = compiled_step(state, raw_d, ref_d, rng, n_real)
+        jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = compiled_step(state, raw_d, ref_d, rng, n_real)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    step_s = dt / steps
+
+    # Preprocessing-vs-model split: time the on-device augment+WB/GC/CLAHE
+    # stage in isolation. In the fused step XLA overlaps/fuses it, so
+    # step_ms is NOT preprocess_ms + model_ms; this isolates how much of
+    # the budget the classical ops alone would cost.
+    pre_fn = jax.jit(lambda r, f, k: engine._preprocess(r, f, k))
+    jax.block_until_ready(pre_fn(raw_d, ref_d, rng))
+    t0 = time.perf_counter()
+    for i in range(steps):
+        out = pre_fn(raw_d, ref_d, rng)
+    jax.block_until_ready(out)
+    pre_s = (time.perf_counter() - t0) / steps
+
+    dev = jax.devices()[0]
+    peak = _peak_tflops(dev)
+    mfu = None
+    if step_tflop is not None and peak:
+        mfu = step_tflop / step_s / peak
+
+    ips = batch / step_s
+    line = {
+        "metric": "uieb_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 2),
+        "step_ms": round(step_s * 1e3, 3),
+        "preprocess_ms": round(pre_s * 1e3, 3),
+        "compile_sec": round(compile_s, 1),
+        "model_tflop_per_step": (
+            round(step_tflop, 4) if step_tflop is not None else None
+        ),
+        "mfu": round(mfu, 5) if mfu is not None else None,
+        "peak_tflops_assumed": peak,
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "batch": batch,
+        "hw": hw,
+        "precision": precision,
+    }
+    # Which classical-op strategies this number was measured with.
     from waternet_tpu.ops.clahe import TILE_GRID, _hist_mode, _interp_mode
 
     ty, tx = TILE_GRID
-    th, tw = HW // ty, HW // tx  # benchmark HW divides the grid
-    return _hist_mode(None), _interp_mode(th, tw)
+    line["clahe_hist"] = _hist_mode(None)
+    line["clahe_interp"] = _interp_mode(hw // ty, hw // tx)
+    return line
 
 
 def _relay_listening(port: int | None = None) -> bool | None:
@@ -316,87 +411,10 @@ def main():
 
     if args.config == "video":
         hw = (HW, HW * 16 // 9) if "WATERNET_BENCH_HW" in os.environ else (1080, 1920)
-        return bench_video(hw=hw, batch=args.batch_size, steps=MEASURE_STEPS)
+        print(json.dumps(bench_video(hw=hw, batch=args.batch_size, steps=MEASURE_STEPS)))
+        return
 
-    from waternet_tpu.data.synthetic import SyntheticPairs
-    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
-
-    config = TrainConfig(
-        batch_size=BATCH, im_height=HW, im_width=HW, precision=PRECISION
-    )
-    engine = TrainingEngine(config)
-
-    data = SyntheticPairs(2 * BATCH, HW, HW, seed=0)
-    idx = np.arange(len(data))
-    batches = list(data.batches(idx, BATCH, shuffle=False, drop_remainder=True))
-    raw, ref = batches[0]
-
-    import jax
-    import jax.numpy as jnp
-
-    raw_d = jnp.asarray(raw)
-    ref_d = jnp.asarray(ref)
-    rng = jax.random.PRNGKey(0)
-    n_real = jnp.asarray(BATCH, jnp.int32)
-
-    # AOT-compile the full fused step once (preprocess + WaterNet + VGG
-    # fwd/bwd + Adam + metrics); the same executable provides XLA's FLOP
-    # count AND runs the measured loop, so the step is compiled exactly once.
-    compiled_step = engine.train_step.lower(
-        engine.state, raw_d, ref_d, rng, n_real
-    ).compile()
-    step_tflop = _compiled_tflops(compiled_step)
-
-    for i in range(WARMUP_STEPS):
-        engine.state, m = compiled_step(engine.state, raw_d, ref_d, rng, n_real)
-    jax.block_until_ready(m["loss"])
-
-    t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        engine.state, m = compiled_step(engine.state, raw_d, ref_d, rng, n_real)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
-    step_s = dt / MEASURE_STEPS
-
-    # Preprocessing-vs-model split: time the on-device augment+WB/GC/CLAHE
-    # stage in isolation. In the fused step XLA overlaps/fuses it, so
-    # step_ms is NOT preprocess_ms + model_ms; this isolates how much of
-    # the budget the classical ops alone would cost.
-    pre_fn = jax.jit(lambda r, f, k: engine._preprocess(r, f, k))
-    jax.block_until_ready(pre_fn(raw_d, ref_d, rng))
-    t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        out = pre_fn(raw_d, ref_d, rng)
-    jax.block_until_ready(out)
-    pre_s = (time.perf_counter() - t0) / MEASURE_STEPS
-
-    dev = jax.devices()[0]
-    peak = _peak_tflops(dev)
-    mfu = None
-    if step_tflop is not None and peak:
-        mfu = step_tflop / step_s / peak
-
-    ips = BATCH / step_s
-    line = {
-        "metric": "uieb_train_images_per_sec_per_chip",
-        "value": round(ips, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(ips / BASELINE_IMG_PER_SEC, 2),
-        "step_ms": round(step_s * 1e3, 3),
-        "preprocess_ms": round(pre_s * 1e3, 3),
-        "model_tflop_per_step": (
-            round(step_tflop, 4) if step_tflop is not None else None
-        ),
-        "mfu": round(mfu, 5) if mfu is not None else None,
-        "peak_tflops_assumed": peak,
-        "device_kind": getattr(dev, "device_kind", str(dev)),
-        "batch": BATCH,
-        "hw": HW,
-        "precision": PRECISION,
-    }
-    # Which classical-op strategies this number was measured with.
-    line["clahe_hist"], line["clahe_interp"] = _clahe_modes()
-    print(json.dumps(line))
+    print(json.dumps(measure_train()))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,82 @@
+"""Unit tests for tools/tpu_session.py pure helpers (no device, no jit)."""
+
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import tpu_session  # noqa: E402
+
+
+def _fake_report():
+    return {
+        "started_utc": "2026-01-01T00:00:00Z",
+        "out_name": "tpu_session.json",
+        "stages": {
+            "init": {
+                "ok": True, "devices": 1, "device_kind": "TPU v5 lite",
+                "platform": "tpu", "init_sec": 1.2, "first_matmul_sec": 0.3,
+                "wall_sec": 1.5,
+            },
+            "train_bf16": {
+                "ok": True, "value": 480.0, "vs_baseline": 40.0,
+                "step_ms": 33.3, "preprocess_ms": 5.0, "compile_sec": 80.0,
+                "model_tflop_per_step": 1.6, "mfu": 0.244,
+                "peak_tflops_assumed": 197.0, "batch": 16, "hw": 112,
+                "precision": "bf16", "clahe_hist": "matmul",
+                "clahe_interp": "matmul", "wall_sec": 120.0,
+            },
+            "video_1080p_batch4": {
+                "ok": True, "metric": "video_1080p_frames_per_sec_per_chip",
+                "value": 25.0, "batch": 4, "frame_ms": 40.0, "wall_sec": 60.0,
+            },
+            "ab_fp32": {
+                "ok": True, "value": 240.0, "step_ms": 66.6,
+                "preprocess_ms": 6.0, "wall_sec": 100.0,
+            },
+            "convergence": {
+                "ok": True, "epochs": 40, "hw": 112, "batch": 16,
+                "csv": "docs/convergence_tpu.csv",
+                "first": {"epoch": 0, "loss": 9000.0, "ssim": 0.3,
+                          "psnr": 10.0, "mse": 9000.0, "images_per_sec": 400},
+                "last": {"epoch": 39, "loss": 500.0, "ssim": 0.8,
+                         "psnr": 20.0, "mse": 500.0, "images_per_sec": 480},
+                "sustained_images_per_sec": 470.0, "wall_sec": 400.0,
+            },
+            "profile": {"ok": False, "error": "RuntimeError: unsupported",
+                        "wall_sec": 2.0},
+        },
+    }
+
+
+def test_render_markdown_covers_all_sections():
+    md = tpu_session._render_markdown(_fake_report())
+    assert "480.0 images/sec/chip" in md          # headline
+    assert "40.0x the reference GPU baseline" in md
+    assert "video_1080p_frames_per_sec_per_chip | 4 | 25.0" in md
+    assert "| fp32 | 240.0 |" in md               # A/B table strips ab_
+    assert "112x112, batch 16, perceptual ON" in md
+    assert "`profile`: RuntimeError: unsupported" in md
+    assert "(in progress / interrupted)" in md    # no finished_utc
+
+
+def test_render_markdown_minimal_report():
+    md = tpu_session._render_markdown(
+        {"started_utc": "x", "stages": {"init": {"ok": False, "error": "e"}}}
+    )
+    assert "Failed stages" in md
+
+
+def test_env_patch_roundtrip(monkeypatch):
+    monkeypatch.setenv("WATERNET_CLAHE_HIST", "scatter")
+    monkeypatch.delenv("WATERNET_CLAHE_INTERP", raising=False)
+    undo = tpu_session._env_patch(
+        {"WATERNET_CLAHE_HIST": "matmul", "WATERNET_CLAHE_INTERP": "gather"}
+    )
+    assert os.environ["WATERNET_CLAHE_HIST"] == "matmul"
+    assert os.environ["WATERNET_CLAHE_INTERP"] == "gather"
+    undo()
+    assert os.environ["WATERNET_CLAHE_HIST"] == "scatter"
+    assert "WATERNET_CLAHE_INTERP" not in os.environ

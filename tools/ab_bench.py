@@ -35,6 +35,9 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bench import _env_int, _relay_listening  # noqa: E402
 
 TRAIN_VARIANTS = [
     ("default_bf16", {}),
@@ -59,13 +62,13 @@ def run_bench(extra_env, args=(), timeout=None):
     env = dict(os.environ)
     env.update(extra_env)
     if timeout is None:
-        sys.path.insert(0, str(REPO))
-        from bench import _env_int  # same parsing as bench.py itself
-
+        # Mirror bench.py's own budget resolution exactly, so the backstop
+        # stays strictly larger than the inner timeout for any env.
+        train_t = _env_int("WATERNET_BENCH_TIMEOUT", 600)
         if "video" in args:
-            inner = _env_int("WATERNET_BENCH_VIDEO_TIMEOUT", 1800)
+            inner = _env_int("WATERNET_BENCH_VIDEO_TIMEOUT", max(1800, train_t))
         else:
-            inner = _env_int("WATERNET_BENCH_TIMEOUT", 600)
+            inner = train_t
         timeout = max(2100, inner + 300)
     t0 = time.perf_counter()
     proc = subprocess.Popen(
@@ -114,9 +117,6 @@ def main():
     p.add_argument("--out", default=str(REPO / "docs" / "bench_ab.json"))
     p.add_argument("--skip-video", action="store_true")
     args = p.parse_args()
-
-    sys.path.insert(0, str(REPO))
-    from bench import _relay_listening
 
     # Non-connecting liveness check: a connect+disconnect on the relay port
     # can itself tear the tunnel down, so never dial it just to probe.

@@ -1,0 +1,436 @@
+"""Capture EVERY open TPU measurement in ONE process / ONE device connection.
+
+The accelerator tunnel is single-client and fragile: a client that connects
+and disconnects can tear it down (see bench._relay_listening). When the chip
+is reachable, tools that spawn one subprocess per measurement (ab_bench) bet
+the whole session on the tunnel surviving many reconnects. This script makes
+the opposite bet: connect once, measure everything, write results to disk
+*incrementally* after every stage so a mid-run tunnel death still leaves all
+completed measurements on disk.
+
+Stages (each independently try/except'd, ordered by judging value):
+
+1. device init + first-op latency (tunnel sanity)
+2. headline train bench: bf16 112x112 batch-16 fused step -> img/s, step_ms,
+   MFU, preprocess split   (VERDICT #1/#2)
+3. 1080p video throughput, batch 4 then 2 then 8   (VERDICT #7)
+4. A/B variants in-process: CLAHE interp gather/matmul, hist
+   scatter/matmul/pallas, fp32   (VERDICT #3/#4)
+5. jax.profiler trace of the compiled step   (VERDICT #3)
+6. synthetic convergence with the perceptual term ON at 112x112/batch-16
+   (quality evidence fallback, VERDICT #6) — longest, last, tunable.
+
+Usage::
+
+    python tools/tpu_session.py [--out docs/tpu_session.json]
+        [--skip-video] [--skip-ab] [--skip-profile]
+        [--convergence-epochs N]   # 0 skips; default 40
+
+Emits progress on stderr and one final JSON summary on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+AB_VARIANTS = [
+    # (name, env overrides) — fresh TrainingEngine per variant re-traces, so
+    # trace-time env reads (ops/clahe._hist_mode/_interp_mode) take effect.
+    ("clahe_interp_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
+    ("clahe_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
+    ("clahe_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter"}),
+    ("clahe_hist_matmul", {"WATERNET_CLAHE_HIST": "matmul"}),
+    ("clahe_hist_pallas", {"WATERNET_CLAHE_HIST": "pallas"}),
+    ("fp32", {"_precision": "fp32"}),
+]
+
+
+class _Session:
+    def __init__(self, out_path: Path):
+        self.out_path = out_path
+        self.report = {
+            "started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "out_name": out_path.name,
+            "stages": {},
+        }
+
+    def save(self) -> None:
+        self.out_path.parent.mkdir(parents=True, exist_ok=True)
+        self.out_path.write_text(json.dumps(self.report, indent=2))
+        try:
+            md = _render_markdown(self.report)
+            (self.out_path.parent / "TPU_RESULTS.md").write_text(md)
+        except Exception as e:  # rendering must never lose measurements
+            print(f"[tpu_session] markdown render failed: {e}", file=sys.stderr)
+
+    def run_stage(self, name: str, fn):
+        print(f"[tpu_session] stage: {name}", file=sys.stderr, flush=True)
+        t0 = time.perf_counter()
+        try:
+            result = fn()
+            entry = {"ok": True, **(result or {})}
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # keep measuring; record the failure
+            entry = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        entry["wall_sec"] = round(time.perf_counter() - t0, 1)
+        self.report["stages"][name] = entry
+        self.save()
+        print(
+            f"[tpu_session] {name}: {json.dumps(entry)[:300]}",
+            file=sys.stderr,
+            flush=True,
+        )
+        return entry
+
+
+def _render_markdown(report) -> str:
+    """docs/TPU_RESULTS.md — measured-on-hardware results, regenerated after
+    every stage so a mid-run tunnel death still leaves a readable report."""
+    lines = [
+        "# TPU measurements (tools/tpu_session.py)",
+        "",
+        f"Session started {report['started_utc']}"
+        + (
+            f", finished {report['finished_utc']}"
+            if "finished_utc" in report
+            else " (in progress / interrupted)"
+        )
+        + f". Raw data: `{report.get('out_name', 'tpu_session.json')}`.",
+        "",
+    ]
+    stages = report["stages"]
+    init = stages.get("init")
+    if init and init.get("ok"):
+        lines += [
+            f"Device: **{init['device_kind']}** ({init['platform']}), "
+            f"init {init['init_sec']}s, first 256x256 bf16 matmul "
+            f"{init['first_matmul_sec']}s.",
+            "",
+        ]
+    train = stages.get("train_bf16")
+    if train and train.get("ok"):
+        import bench
+
+        vs = train.get("vs_baseline")
+        lines += [
+            f"## Headline: fused train step ({train['hw']}x{train['hw']}, "
+            f"batch {train['batch']}, {train['precision']})",
+            "",
+            f"- **{train['value']} images/sec/chip** "
+            f"({vs}x the reference GPU baseline of "
+            f"{bench.BASELINE_IMG_PER_SEC:g} img/s)",
+            f"- step {train['step_ms']} ms | on-device classical preprocessing "
+            f"alone {train['preprocess_ms']} ms | compile {train['compile_sec']} s",
+            f"- {train['model_tflop_per_step']} TFLOP/step (XLA cost model) -> "
+            f"MFU {train['mfu']} vs {train['peak_tflops_assumed']} TFLOP/s peak",
+            f"- CLAHE strategies: hist={train['clahe_hist']}, "
+            f"interp={train['clahe_interp']}",
+            "",
+        ]
+    video = [
+        (k, v) for k, v in stages.items() if k.startswith("video_") and v.get("ok")
+    ]
+    if video:
+        lines += [
+            "## Full-resolution video enhancement throughput",
+            "",
+            "| metric | batch | frames/sec/chip | ms/frame |",
+            "|---|---|---|---|",
+        ]
+        for k, v in video:
+            lines.append(
+                f"| {v['metric']} | {v['batch']} | {v['value']} | "
+                f"{v['frame_ms']} |"
+            )
+        lines.append("")
+    ab = [(k, v) for k, v in stages.items() if k.startswith("ab_") and v.get("ok")]
+    if ab:
+        lines += [
+            "## A/B variants",
+            "",
+            "| variant | img/s | step ms | preprocess ms |",
+            "|---|---|---|---|",
+        ]
+        for k, v in ab:
+            lines.append(
+                f"| {k[3:]} | {v['value']} | {v['step_ms']} | "
+                f"{v['preprocess_ms']} |"
+            )
+        lines.append("")
+    conv = stages.get("convergence")
+    if conv and conv.get("ok") and conv.get("last"):
+        first, last = conv["first"], conv["last"]
+        lines += [
+            f"## Synthetic convergence ({conv.get('hw')}x{conv.get('hw')}, "
+            f"batch {conv.get('batch')}, perceptual ON)",
+            "",
+            f"{conv['epochs']} epochs, sustained "
+            f"**{conv['sustained_images_per_sec']} images/sec/chip** "
+            f"(epoch curve: `{Path(conv['csv']).name}`).",
+            "",
+            f"- epoch 0: loss {first['loss']:.1f}, ssim {first['ssim']:.4f}, "
+            f"psnr {first['psnr']:.2f}",
+            f"- epoch {last['epoch']}: loss {last['loss']:.1f}, "
+            f"ssim {last['ssim']:.4f}, psnr {last['psnr']:.2f}",
+            "",
+        ]
+    failed = [(k, v) for k, v in stages.items() if not v.get("ok")]
+    if failed:
+        lines += ["## Failed stages", ""]
+        for k, v in failed:
+            lines.append(f"- `{k}`: {v.get('error', 'unknown')}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _env_patch(overrides):
+    """Apply {k: v} to os.environ, returning an undo callable."""
+    saved = {k: os.environ.get(k) for k in overrides}
+
+    def undo():
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    for k, v in overrides.items():
+        os.environ[k] = v
+    return undo
+
+
+def stage_init():
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    init_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    (x @ x).block_until_ready()
+    first_op_s = time.perf_counter() - t0
+    d = devs[0]
+    return {
+        "devices": len(devs),
+        "device_kind": getattr(d, "device_kind", str(d)),
+        "platform": d.platform,
+        "init_sec": round(init_s, 2),
+        "first_matmul_sec": round(first_op_s, 2),
+    }
+
+
+def stage_profile(trace_dir: Path, hw: int = 112, batch: int = 16):
+    """jax.profiler trace around a few compiled train steps. Remote/tunnel
+    backends may not support trace capture — failure here is recorded, not
+    fatal."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    config = TrainConfig(batch_size=batch, im_height=hw, im_width=hw)
+    engine = TrainingEngine(config)
+    data = SyntheticPairs(2 * batch, hw, hw, seed=0)
+    raw, ref = next(
+        iter(
+            data.batches(
+                np.arange(2 * batch), batch, shuffle=False, drop_remainder=True
+            )
+        )
+    )
+    raw_d, ref_d = jnp.asarray(raw), jnp.asarray(ref)
+    rng = jax.random.PRNGKey(0)
+    n_real = jnp.asarray(batch, jnp.int32)
+    state = engine.state
+    state, m = engine.train_step(state, raw_d, ref_d, rng, n_real)  # compile
+    jax.block_until_ready(m["loss"])
+    trace_dir.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(trace_dir)):
+        for _ in range(3):
+            state, m = engine.train_step(state, raw_d, ref_d, rng, n_real)
+        jax.block_until_ready(m["loss"])
+    n_files = sum(1 for _ in trace_dir.rglob("*") if _.is_file())
+    return {"trace_dir": str(trace_dir), "trace_files": n_files}
+
+
+def stage_convergence(epochs: int, out_csv: Path, hw: int = 112, batch: int = 16):
+    """Synthetic training with the perceptual term ON — the env has no
+    UIEB/pretrained-VGG, so this is the strongest available quality
+    evidence: a loss/SSIM/PSNR curve plus sustained throughput from real
+    hardware."""
+    import numpy as np
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    n_pairs = 8 * batch
+    config = TrainConfig(batch_size=batch, im_height=hw, im_width=hw)
+    engine = TrainingEngine(config)
+    data = SyntheticPairs(n_pairs, hw, hw, seed=0)
+    idx = np.arange(n_pairs)
+    rows = []
+    t_start = time.perf_counter()
+    for epoch in range(epochs):
+        t0 = time.perf_counter()
+        batches = data.batches(idx, batch, shuffle=True, epoch=epoch)
+        m = engine.train_epoch(batches, epoch=epoch)
+        dt = time.perf_counter() - t0
+        rows.append(
+            {
+                "epoch": epoch,
+                "loss": float(m["loss"]),
+                "mse": float(m["mse"]),
+                "ssim": float(m["ssim"]),
+                "psnr": float(m["psnr"]),
+                "images_per_sec": round(n_pairs // batch * batch / dt, 2),
+            }
+        )
+    out_csv.parent.mkdir(parents=True, exist_ok=True)
+    with open(out_csv, "w") as f:
+        f.write("epoch,loss,mse,ssim,psnr,images_per_sec\n")
+        for r in rows:
+            f.write(
+                f"{r['epoch']},{r['loss']:.6f},{r['mse']:.4f},"
+                f"{r['ssim']:.6f},{r['psnr']:.4f},{r['images_per_sec']}\n"
+            )
+    wall = time.perf_counter() - t_start
+    return {
+        "epochs": epochs,
+        "hw": hw,
+        "batch": batch,
+        "csv": str(out_csv),
+        "first": rows[0] if rows else None,
+        "last": rows[-1] if rows else None,
+        "sustained_images_per_sec": (
+            round(
+                sum(r["images_per_sec"] for r in rows[1:])
+                / max(1, len(rows) - 1),
+                2,
+            )
+            if len(rows) > 1
+            else None
+        ),
+        "wall_sec": round(wall, 1),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=str(REPO / "docs" / "tpu_session.json"))
+    p.add_argument("--skip-video", action="store_true")
+    p.add_argument("--skip-ab", action="store_true")
+    p.add_argument("--skip-profile", action="store_true")
+    p.add_argument("--convergence-epochs", type=int, default=40)
+    p.add_argument(
+        "--train-steps", type=int, default=30,
+        help="measured steps for the train benches",
+    )
+    p.add_argument(
+        "--hw", type=int, default=112,
+        help="train/AB/profile/convergence image size (reduce for CPU smoke)",
+    )
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument(
+        "--video-height", type=int, default=1080,
+        help="video stage frame height (width = 16:9)",
+    )
+    args = p.parse_args()
+
+    import bench
+    from waternet_tpu.utils.platform import enable_compile_cache, ensure_platform
+
+    if bench._relay_listening() is False:
+        print(
+            "[tpu_session] aborting: tunnel relay is not listening",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+
+    ensure_platform()
+    enable_compile_cache()
+
+    s = _Session(Path(args.out))
+    s.run_stage("init", stage_init)
+    if not s.report["stages"]["init"]["ok"]:
+        print(json.dumps(s.report))
+        raise SystemExit(1)
+
+    # Headline first: if the tunnel dies mid-session this is the number
+    # that matters most.
+    s.run_stage(
+        "train_bf16",
+        lambda: bench.measure_train(
+            batch=args.batch, hw=args.hw, precision="bf16", warmup=3,
+            steps=args.train_steps,
+        ),
+    )
+
+    if not args.skip_video:
+        vh = args.video_height
+        for b in (4, 2, 8):
+            s.run_stage(
+                f"video_{vh}p_batch{b}",
+                lambda b=b: bench.bench_video(
+                    hw=(vh, vh * 16 // 9), batch=b, steps=12
+                ),
+            )
+
+    if not args.skip_ab:
+        for name, overrides in AB_VARIANTS:
+            precision = overrides.get("_precision", "bf16")
+            env = {k: v for k, v in overrides.items() if not k.startswith("_")}
+            undo = _env_patch(env)
+            try:
+                s.run_stage(
+                    f"ab_{name}",
+                    lambda: bench.measure_train(
+                        batch=args.batch,
+                        hw=args.hw,
+                        precision=precision,
+                        warmup=2,
+                        steps=args.train_steps,
+                    ),
+                )
+            finally:
+                undo()
+
+    if not args.skip_profile:
+        s.run_stage(
+            "profile",
+            lambda: stage_profile(
+                REPO / "docs" / "profile_trace", hw=args.hw, batch=args.batch
+            ),
+        )
+
+    if args.convergence_epochs > 0:
+        s.run_stage(
+            "convergence",
+            lambda: stage_convergence(
+                args.convergence_epochs,
+                REPO / "docs" / "convergence_tpu.csv",
+                hw=args.hw,
+                batch=args.batch,
+            ),
+        )
+
+    s.report["finished_utc"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+    s.save()
+    print(json.dumps(s.report))
+
+
+if __name__ == "__main__":
+    main()
