@@ -1,0 +1,80 @@
+"""Tests for waternet_tpu.utils.platform.is_tpu_backend.
+
+The tunnelled PJRT plugin registers its backend under a non-"tpu" platform
+name while executing on a real TPU, so strategy selection must not key on
+``jax.default_backend() == "tpu"`` alone (that silently picked CPU-tuned
+CLAHE modes on the chip).
+"""
+
+import jax
+import pytest
+
+from waternet_tpu.utils import platform as plat
+
+
+class _FakeDev:
+    def __init__(self, platform="", device_kind=""):
+        self.platform = platform
+        self.device_kind = device_kind
+
+
+def test_cpu_backend_is_not_tpu():
+    # The suite runs with JAX_PLATFORMS=cpu (conftest).
+    assert jax.default_backend() == "cpu"
+    assert plat.is_tpu_backend() is False
+
+
+@pytest.mark.parametrize(
+    "backend,dev,env_gen,want",
+    [
+        ("tpu", _FakeDev(), None, True),
+        ("cuda", _FakeDev("tpu"), "v5e", False),  # named GPU wins
+        # Opaque plugin name: device attributes decide.
+        ("axon", _FakeDev(platform="tpu"), None, True),
+        ("axon", _FakeDev(device_kind="TPU v5 lite"), None, True),
+        # Opaque name + opaque device: env generation hint decides.
+        ("axon", _FakeDev(), "v5e", True),
+        ("axon", _FakeDev(), None, False),
+    ],
+)
+def test_opaque_plugin_detection(monkeypatch, backend, dev, env_gen, want):
+    monkeypatch.setattr(jax, "default_backend", lambda: backend)
+    monkeypatch.setattr(jax, "devices", lambda: [dev])
+    if env_gen is None:
+        monkeypatch.delenv("PALLAS_AXON_TPU_GEN", raising=False)
+    else:
+        monkeypatch.setenv("PALLAS_AXON_TPU_GEN", env_gen)
+    assert plat.is_tpu_backend() is want
+
+
+def test_devices_failure_falls_back_to_env(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+
+    def boom():
+        raise RuntimeError("tunnel down")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
+    assert plat.is_tpu_backend() is True
+    monkeypatch.delenv("PALLAS_AXON_TPU_GEN")
+    assert plat.is_tpu_backend() is False
+
+
+def test_clahe_auto_modes_follow_tpu_detection(monkeypatch):
+    """The CLAHE strategy autos must ride is_tpu_backend, not the raw
+    platform-name string (the original bug)."""
+    import importlib
+
+    # The package re-exports a `clahe` *function*, shadowing the submodule
+    # for `import ... as`; resolve the module itself.
+    clahe = importlib.import_module("waternet_tpu.ops.clahe")
+
+    monkeypatch.delenv("WATERNET_CLAHE_INTERP", raising=False)
+    monkeypatch.delenv("WATERNET_CLAHE_HIST", raising=False)
+    monkeypatch.delenv("WATERNET_PALLAS", raising=False)
+    monkeypatch.setattr(plat, "is_tpu_backend", lambda: True)
+    assert clahe._interp_mode(14, 14) == "matmul"
+    assert clahe._hist_mode(None) == "matmul"
+    monkeypatch.setattr(plat, "is_tpu_backend", lambda: False)
+    assert clahe._interp_mode(14, 14) == "gather"
+    assert clahe._hist_mode(None) == "scatter"

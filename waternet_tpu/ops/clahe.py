@@ -96,7 +96,9 @@ def _interp_mode(th: int, tw: int) -> str:
     forced = os.environ.get("WATERNET_CLAHE_INTERP", "").strip().lower()
     if forced in ("gather", "matmul"):
         return forced
-    return "matmul" if jax.default_backend() == "tpu" else "gather"
+    from waternet_tpu.utils.platform import is_tpu_backend
+
+    return "matmul" if is_tpu_backend() else "gather"
 
 
 def _hist_mode(use_pallas) -> str:
@@ -121,7 +123,9 @@ def _hist_mode(use_pallas) -> str:
 
     if pallas_enabled():
         return "pallas"
-    if jax.default_backend() == "tpu":
+    from waternet_tpu.utils.platform import is_tpu_backend
+
+    if is_tpu_backend():
         return "matmul"
     return "scatter"
 

@@ -76,9 +76,13 @@ def tile_histogram(tiles: jnp.ndarray, interpret: bool | None = None) -> jnp.nda
     """(T, A) uint8-valued tiles -> (T, 256) int32 histograms.
 
     Pallas comparison-reduction kernel; pad pixels (value -1) fall outside
-    every bin so partial chunks need no masking. On CPU backends (where only
-    the Pallas interpreter exists) interpret mode is selected automatically.
+    every bin so partial chunks need no masking. The Mosaic TPU kernel only
+    lowers on real TPU backends (including tunnelled plugins that register
+    under another platform name); everywhere else interpret mode is
+    selected automatically.
     """
     if interpret is None:
-        interpret = jax.default_backend() == "cpu"
+        from waternet_tpu.utils.platform import is_tpu_backend
+
+        interpret = not is_tpu_backend()
     return _tile_histogram_impl(tiles, interpret)

@@ -43,6 +43,36 @@ def enable_compile_cache() -> None:
         pass  # cache is an optimization; never fail startup over it
 
 
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend executes on TPU hardware.
+
+    ``jax.default_backend() == "tpu"`` is NOT sufficient: tunnelled PJRT
+    plugins register under their own platform name (e.g. ``"axon"``) while
+    still compiling for and executing on a TPU (the plugin aliases the TPU
+    MLIR lowering rules). Strategy choices that key on "is this a TPU"
+    (MXU-friendly CLAHE modes, Pallas kernels) must use this helper, or
+    they silently pick CPU-tuned paths on the real chip.
+    """
+    import jax
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return True
+    if backend in ("cpu", "gpu", "cuda", "rocm"):
+        return False
+    # Opaque plugin platform: trust the device's own attributes first,
+    # then the TPU-generation hint the tunnel environment exports.
+    try:
+        d = jax.devices()[0]
+        if getattr(d, "platform", "") == "tpu":
+            return True
+        if "tpu" in getattr(d, "device_kind", "").lower():
+            return True
+    except Exception:
+        pass
+    return bool(os.environ.get("PALLAS_AXON_TPU_GEN"))
+
+
 def ensure_platform() -> None:
     want = (
         os.environ.get("WATERNET_TPU_PLATFORM")
