@@ -1,0 +1,42 @@
+"""Unit test for tools/host_bench.py's pure markdown renderer."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import host_bench  # noqa: E402
+
+
+def test_render_markdown_all_sections():
+    report = {
+        "config": {"hw": 112, "batch": 16, "steps": 5, "n_images": 64},
+        "data_pipeline": {
+            "reference": {"images_per_sec": 161.0},
+            "ours": {
+                "host_parity_images_per_sec": 536.0,
+                "cached_feed_images_per_sec": 56654.0,
+                "first_epoch_decode_sec": 0.05,
+            },
+        },
+        "train_step": {
+            "reference": {"images_per_sec": 1.2, "step_ms": 13000.0},
+            "ours": {"images_per_sec": 0.9, "step_ms": 18000.0,
+                     "compile_sec": 6.0},
+        },
+        "forward_latency": {
+            "112x112": {"reference_torch_ms": 230.0, "ours_jax_ms": 290.0,
+                        "speedup": 0.79},
+        },
+    }
+    md = host_bench.render_markdown(report)
+    assert "| reference per-item (re-decode every epoch) | 161.0 |" in md
+    assert "| ours: host parity path (decode-once cache + batched cv2) | 536.0 |" in md
+    assert "no preprocessing, no metrics" in md
+    assert "| 112x112 | 230.0 | 290.0 | 0.79x |" in md
+
+
+def test_render_markdown_partial_report():
+    md = host_bench.render_markdown({"config": {"hw": 112, "batch": 16}})
+    assert "Same-host CPU comparison" in md
