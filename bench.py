@@ -81,9 +81,10 @@ def _compiled_tflops(lowered_compiled) -> float | None:
         return None
 
 
-def bench_video(hw=(1080, 1920), batch=4, steps=12):
+def bench_video(hw=(1080, 1920), batch=4, steps=12, quantize=None):
     """Secondary benchmark: full-res video-frame enhancement throughput
     (BASELINE config 5), double-buffered like the video CLI path.
+    ``quantize`` (default: WATERNET_QUANT=1) A/Bs the static-int8 MXU path.
     Returns the JSON-line dict (the CLI prints it)."""
     import jax
 
@@ -94,11 +95,14 @@ def bench_video(hw=(1080, 1920), batch=4, steps=12):
 
     import jax.numpy as jnp
 
+    if quantize is None:
+        quantize = os.environ.get("WATERNET_QUANT") == "1"
     h, w = hw
     x = jnp.zeros((1, 16, 16, 3), jnp.float32)
     params = WaterNet(dtype=jnp.bfloat16).init(jax.random.PRNGKey(0), x, x, x, x)
     engine = InferenceEngine(
-        params=params, device_preprocess=True, dtype=jnp.bfloat16
+        params=params, device_preprocess=True, dtype=jnp.bfloat16,
+        quantize=quantize,
     )
     frames = np.stack(
         [SyntheticPairs(1, h, w, seed=i).load_pair(0)[0] for i in range(batch)]
@@ -124,6 +128,7 @@ def bench_video(hw=(1080, 1920), batch=4, steps=12):
         "batch": batch,
         "frame_ms": round(dt / (batch * steps) * 1e3, 3),
         "compile_sec": round(compile_s, 1),
+        "quantized": bool(quantize),
     }
 
 

@@ -88,7 +88,47 @@ def parse_args(argv=None):
         help="(Optional) Split each image's height over N devices with exact "
         "halo exchange (for frames too large for one chip).",
     )
+    parser.add_argument(
+        "--quantize",
+        action="store_true",
+        default=False,
+        help="(Optional) Static int8 inference (MXU double-rate path; "
+        "typically >40 dB PSNR vs the float forward).",
+    )
     return parser.parse_args(argv)
+
+
+def calibration_from_sources(files, limit: int = 4):
+    """(x, wb, ce, gc) float batches from the user's own inputs, for int8
+    activation-scale calibration (`waternet_tpu.models.quant`). Images are
+    used directly; for a video the first ``limit`` frames are sampled.
+    Each image becomes its own batch — scales are size-agnostic."""
+    import cv2
+
+    from waternet_tpu.ops import transform_np
+
+    def as_batch(rgb):
+        wb, gc, he = transform_np(rgb)
+        f = lambda a: a[None].astype(np.float32) / 255.0
+        return (f(rgb), f(wb), f(he), f(gc))
+
+    batches = []
+    for f in files:
+        if len(batches) >= limit:
+            break
+        if f.suffix.lower() in IM_SUFFIXES:
+            im = cv2.imread(str(f))
+            if im is not None:
+                batches.append(as_batch(cv2.cvtColor(im, cv2.COLOR_BGR2RGB)))
+        elif f.suffix.lower() in VID_SUFFIXES:
+            cap = cv2.VideoCapture(str(f))
+            while len(batches) < limit:
+                ok, frame = cap.read()
+                if not ok:
+                    break
+                batches.append(as_batch(cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)))
+            cap.release()
+    return batches or None  # fall back to synthetic defaults if unreadable
 
 
 def annotate_split(composite, width_split, label_before="Before", label_after="After"):
@@ -205,6 +245,10 @@ def main(argv=None):
         device_preprocess=args.device_preprocess,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         spatial_shards=args.spatial_shards,
+        quantize=args.quantize,
+        # Calibrate int8 activation scales on the ACTUAL inputs (not the
+        # synthetic defaults) so out-of-range activations aren't clipped.
+        calib_batches=calibration_from_sources(files) if args.quantize else None,
     )
 
     savedir = next_run_dir(Path(__file__).parent / "output", args.name)

@@ -1,0 +1,116 @@
+"""Tests for static int8 post-training quantization (models/quant.py).
+
+The quant forward mirrors the WaterNet topology
+(`/root/reference/waternet/net.py:7-108`) functionally; these tests pin
+(1) that the functional float topology is bit-identical to the Flax module,
+(2) that int8 inference stays within a tight PSNR budget of the float
+output, and (3) that the engine/CLI integration runs end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from waternet_tpu.models import WaterNet
+from waternet_tpu.models.quant import (
+    default_calibration_inputs,
+    float_forward,
+    quant_forward,
+    quantize_waternet,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = WaterNet()
+    x0 = jnp.ones((1, 48, 48, 3)) * 0.5
+    params = model.init(jax.random.PRNGKey(0), x0, x0, x0, x0)
+    calib = default_calibration_inputs(n=4, hw=48)
+    return model, params, calib
+
+
+def test_functional_topology_matches_flax_module(setup):
+    model, params, calib = setup
+    x, wb, he, gc = (jnp.asarray(a) for a in calib[0])
+    ref = model.apply(params, x, wb, he, gc)
+    got = float_forward(params, x, wb, he, gc)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_int8_forward_close_to_float(setup):
+    model, params, calib = setup
+    x, wb, he, gc = (jnp.asarray(a) for a in calib[0])
+    ref = model.apply(params, x, wb, he, gc)
+    q = quantize_waternet(params, calib)
+    out = jax.jit(quant_forward)(q, x, wb, he, gc)
+    assert out.dtype == jnp.float32
+    err = float(jnp.mean((out - ref) ** 2))
+    peak = float(jnp.max(jnp.abs(ref))) or 1.0
+    psnr = 10 * np.log10(peak**2 / err)
+    assert psnr > 38.0, f"int8 PSNR vs float too low: {psnr:.1f} dB"
+
+
+def test_int8_forward_close_on_held_out_inputs(setup):
+    """PSNR budget on inputs the calibrator never saw — the deployment
+    regime, where out-of-range activations get clipped."""
+    model, params, calib = setup
+    q = quantize_waternet(params, calib)
+    held_out = default_calibration_inputs(n=4, hw=48, seed=123)
+    x, wb, he, gc = (jnp.asarray(a) for a in held_out[0])
+    ref = model.apply(params, x, wb, he, gc)
+    out = jax.jit(quant_forward)(q, x, wb, he, gc)
+    err = float(jnp.mean((out - ref) ** 2))
+    peak = float(jnp.max(jnp.abs(ref))) or 1.0
+    psnr = 10 * np.log10(peak**2 / err)
+    assert psnr > 35.0, f"held-out int8 PSNR vs float too low: {psnr:.1f} dB"
+
+
+def test_quantize_deterministic_and_int8(setup):
+    _, params, calib = setup
+    q1 = quantize_waternet(params, calib)
+    q2 = quantize_waternet(params, calib)
+    for branch in q1:
+        for l1, l2 in zip(q1[branch], q2[branch]):
+            assert l1["wq"].dtype == jnp.int8
+            np.testing.assert_array_equal(np.asarray(l1["wq"]), np.asarray(l2["wq"]))
+            assert float(l1["s_in"]) == float(l2["s_in"])
+
+
+def test_calibration_scales_track_input_range(setup):
+    """Scaling the calibration inputs scales the input quant scales."""
+    _, params, _ = setup
+    rng = np.random.default_rng(0)
+    batch = tuple(rng.random((2, 48, 48, 3), np.float32) for _ in range(4))
+    q_small = quantize_waternet(params, [tuple(0.1 * b for b in batch)])
+    q_big = quantize_waternet(params, [batch])
+    s_small = float(q_small["cmg"][0]["s_in"])
+    s_big = float(q_big["cmg"][0]["s_in"])
+    assert s_big > s_small
+    np.testing.assert_allclose(s_big, 10 * s_small, rtol=1e-5)
+
+
+def test_inference_engine_quantized(setup):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    _, params, calib = setup
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (2, 48, 48, 3), dtype=np.uint8)
+    eng_f = InferenceEngine(params=params, device_preprocess=True)
+    eng_q = InferenceEngine(
+        params=params, device_preprocess=True, quantize=True,
+        calib_batches=calib,
+    )
+    out_f = eng_f.enhance(frames)
+    out_q = eng_q.enhance(frames)
+    assert out_q.shape == frames.shape and out_q.dtype == np.uint8
+    # uint8 outputs of the two paths differ by at most a few levels.
+    assert np.mean(np.abs(out_q.astype(int) - out_f.astype(int))) < 2.0
+
+
+def test_quantize_with_spatial_shards_rejected(setup):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    _, params, _ = setup
+    with pytest.raises(ValueError, match="spatial_shards"):
+        InferenceEngine(params=params, quantize=True, spatial_shards=2)

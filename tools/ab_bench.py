@@ -146,6 +146,7 @@ def main():
             ("video_interp_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
             ("video_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
             ("video_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter"}),
+            ("video_int8", {"WATERNET_QUANT": "1"}),
         ):
             print(f"[ab_bench] {name}", file=sys.stderr)
             report["video"][name] = run_bench(

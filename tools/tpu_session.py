@@ -386,6 +386,13 @@ def main():
                     hw=(vh, vh * 16 // 9), batch=b, steps=12
                 ),
             )
+        # int8 A/B at the default batch: the MXU double-rate inference path.
+        s.run_stage(
+            f"video_{vh}p_batch4_int8",
+            lambda: bench.bench_video(
+                hw=(vh, vh * 16 // 9), batch=4, steps=12, quantize=True
+            ),
+        )
 
     if not args.skip_ab:
         for name, overrides in AB_VARIANTS:

@@ -35,12 +35,22 @@ class InferenceEngine:
         device_preprocess: bool = False,
         dtype=jnp.float32,
         spatial_shards: int = 1,
+        quantize: bool = False,
+        calib_batches=None,
     ):
         """``spatial_shards > 1`` splits each image's height over that many
         devices with exact halo-exchange (see waternet_tpu.parallel.spatial)
         — for frames too large for one chip's HBM. Requires
         ``spatial_shards`` devices and H divisible by it with slabs >= 26
-        rows."""
+        rows.
+
+        ``quantize=True`` converts the checkpoint to static int8 at
+        construction (see :mod:`waternet_tpu.models.quant`): int8 x int8
+        convs ride the MXU's double-rate int8 path and halve activation HBM
+        traffic — the fast path for full-resolution video. Activation
+        scales calibrate on ``calib_batches`` ((x, wb, ce, gc) float tuples)
+        or on synthetic frames by default; output typically agrees with the
+        float forward to >40 dB PSNR."""
         from waternet_tpu.utils.platform import ensure_platform
 
         ensure_platform()
@@ -55,8 +65,14 @@ class InferenceEngine:
             )
         self.params = params
         self.device_preprocess = device_preprocess
+        self.quantized = quantize
 
         self.spatial_shards = spatial_shards
+        if quantize and spatial_shards > 1:
+            raise ValueError(
+                "quantize=True with spatial_shards > 1 is not supported yet "
+                "(the halo-exchange path runs the float module)"
+            )
         if spatial_shards > 1:
             from waternet_tpu.parallel.mesh import make_mesh
             from waternet_tpu.parallel.spatial import spatial_sharded_apply
@@ -64,6 +80,14 @@ class InferenceEngine:
             mesh = make_mesh(n_data=1, n_spatial=spatial_shards)
             # Already jitted; do not wrap in another jax.jit layer.
             _forward = spatial_sharded_apply(self.module, mesh)
+        elif quantize:
+            from waternet_tpu.models.quant import quant_forward, quantize_waternet
+
+            # quant_forward(qtree, x, wb, ce, gc) has the same signature
+            # shape as module.apply(params, ...), so the qtree simply
+            # replaces the params for every downstream path.
+            self.params = quantize_waternet(params, calib_batches)
+            _forward = jax.jit(quant_forward)
         else:
             _forward = jax.jit(self.module.apply)
 

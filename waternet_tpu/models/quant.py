@@ -1,0 +1,195 @@
+"""Post-training int8 quantization of WaterNet for inference.
+
+A beyond-parity, TPU-first inference path the reference has no analog of:
+the TPU's MXU runs int8 x int8 -> int32 at roughly twice the bf16 rate
+(v5e: ~394 TOPS int8 vs ~197 TFLOP/s bf16) and int8 activations halve the
+HBM bytes per conv — exactly the regime of full-resolution video
+enhancement, which is this model's heaviest inference workload
+(reference behavior being one fp32 frame at a time,
+`/root/reference/inference.py:261-323`).
+
+Scheme: static symmetric PTQ.
+
+* Weights: per-output-channel symmetric int8 (scale = absmax/127 per
+  channel), computed directly from the float checkpoint.
+* Activations: per-conv-input symmetric int8 with scales calibrated as the
+  running absmax over calibration batches (all model inputs live in [0,1],
+  so scales are tightly bounded and synthetic calibration frames work —
+  see :func:`default_calibration_inputs`).
+* Each conv runs int8 x int8 -> int32 (``preferred_element_type``), then a
+  float rescale ``s_in * s_w[c]`` + bias + activation. Concats/activations
+  stay float; every conv re-quantizes its own input. XLA fuses the
+  quantize/rescale elementwise chains into the conv epilogues.
+
+The forward topology mirrors :class:`waternet_tpu.models.WaterNet`
+(reference spec `/root/reference/waternet/net.py:7-108`): the 8-conv
+confidence-map trunk with sigmoid head, three 3-conv refiner branches, and
+the gated-fusion sum — expressed functionally over the quantized layer
+pytree so the whole thing jits as one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from waternet_tpu.models.waternet import _CMG_SPEC, _REFINER_SPEC
+
+# Derived from the Flax module's own layer specs so trunk-depth changes in
+# waternet.py can't silently drift from the quantized topology.
+_CMG_ACTS = ["relu"] * len(_CMG_SPEC) + ["sigmoid"]
+_REFINER_ACTS = ["relu"] * (len(_REFINER_SPEC) + 1)
+_BRANCHES: Tuple[Tuple[str, int], ...] = (
+    ("cmg", len(_CMG_ACTS)),
+    ("wb_refiner", len(_REFINER_ACTS)),
+    ("ce_refiner", len(_REFINER_ACTS)),
+    ("gc_refiner", len(_REFINER_ACTS)),
+)
+_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _layer_tree(params) -> Dict[str, List[dict]]:
+    """Flax WaterNet params -> {branch: [ {kernel, bias}, ... ]}."""
+    p = params["params"] if "params" in params else params
+    return {
+        name: [p[name][f"Conv_{i}"] for i in range(n)]
+        for name, n in _BRANCHES
+    }
+
+
+def _conv_f32(layer, x):
+    y = lax.conv_general_dilated(
+        x, layer["kernel"].astype(x.dtype), (1, 1), "SAME",
+        dimension_numbers=_DN,
+    )
+    return y + layer["bias"].astype(x.dtype)
+
+
+def _conv_int8(qlayer, x):
+    """Quantize input with the calibrated scale, int8 conv, float rescale."""
+    xq = jnp.clip(jnp.round(x / qlayer["s_in"]), -127, 127).astype(jnp.int8)
+    y = lax.conv_general_dilated(
+        xq, qlayer["wq"], (1, 1), "SAME",
+        dimension_numbers=_DN,
+        preferred_element_type=jnp.int32,
+    )
+    return y.astype(jnp.float32) * qlayer["rescale"] + qlayer["bias"]
+
+
+def _forward(layers, x, wb, ce, gc, conv, observe=None):
+    """Shared WaterNet topology over a per-layer ``conv`` primitive.
+
+    ``observe(branch, i, inp)`` (calibration hook) sees every conv input.
+    """
+
+    def run(branch, inp, acts):
+        for i, act in enumerate(acts):
+            if observe is not None:
+                observe(branch, i, inp)
+            out = conv(layers[branch][i], inp)
+            inp = jax.nn.sigmoid(out) if act == "sigmoid" else jax.nn.relu(out)
+        return inp
+
+    cm = run("cmg", jnp.concatenate([x, wb, ce, gc], axis=-1), _CMG_ACTS)
+    fused = 0.0
+    for name, var, sl in (
+        ("wb_refiner", wb, 0), ("ce_refiner", ce, 1), ("gc_refiner", gc, 2)
+    ):
+        refined = run(name, jnp.concatenate([x, var], axis=-1), _REFINER_ACTS)
+        fused = fused + refined * cm[..., sl:sl + 1]
+    return fused.astype(jnp.float32)
+
+
+def float_forward(params, x, wb, ce, gc):
+    """fp32 reference forward over the same functional topology (used to
+    validate that the topology matches the Flax module exactly)."""
+    return _forward(_layer_tree(params), x, wb, ce, gc, _conv_f32)
+
+
+def calibration_stats(params, batches: Sequence[Tuple]) -> Dict[str, float]:
+    """absmax of every conv input over the calibration batches.
+
+    ``batches`` yields (x, wb, ce, gc) float arrays in [0, 1].
+    """
+    layers = _layer_tree(params)
+
+    @jax.jit
+    def one(x, wb, ce, gc):
+        stats = {}
+
+        def observe(branch, i, inp):
+            stats[f"{branch}/{i}"] = jnp.max(jnp.abs(inp))
+
+        _forward(layers, x, wb, ce, gc, _conv_f32, observe=observe)
+        return stats
+
+    agg: Dict[str, float] = {}
+    for x, wb, ce, gc in batches:
+        stats = jax.device_get(
+            one(jnp.asarray(x), jnp.asarray(wb), jnp.asarray(ce),
+                jnp.asarray(gc))
+        )
+        for k, v in stats.items():
+            agg[k] = max(agg.get(k, 0.0), float(v))
+    return agg
+
+
+def default_calibration_inputs(n: int = 8, hw: int = 112, seed: int = 0):
+    """Synthetic calibration batch: WB/GC/CLAHE variants of synthetic
+    underwater frames — same input distribution shape ([0,1], enhanced
+    variants included) the model sees at inference."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.ops import transform_np
+
+    data = SyntheticPairs(n, hw, hw, seed=seed)
+    xs, wbs, hes, gcs = [], [], [], []
+    for i in range(n):
+        raw, _ = data.load_pair(i)
+        wb, gc, he = transform_np(raw)
+        xs.append(raw)
+        wbs.append(wb)
+        hes.append(he)
+        gcs.append(gc)
+    f = lambda a: np.stack(a).astype(np.float32) / 255.0
+    return [(f(xs), f(wbs), f(hes), f(gcs))]
+
+
+def quantize_waternet(params, calib_batches=None):
+    """Float checkpoint -> int8 inference pytree.
+
+    Returns {branch: [ {wq, bias, s_in, rescale}, ... ]} where ``wq`` is the
+    per-output-channel int8 kernel, ``s_in`` the calibrated input scale and
+    ``rescale = s_in * s_w`` the per-channel output dequantization factor.
+    """
+    if calib_batches is None:
+        calib_batches = default_calibration_inputs()
+    stats = calibration_stats(params, calib_batches)
+    layers = _layer_tree(params)
+    qtree: Dict[str, List[dict]] = {}
+    for branch, convs in layers.items():
+        qconvs = []
+        for i, layer in enumerate(convs):
+            w = np.asarray(layer["kernel"], np.float32)  # (kh, kw, in, out)
+            s_w = np.abs(w).reshape(-1, w.shape[-1]).max(axis=0) / 127.0
+            s_w = np.maximum(s_w, 1e-12)
+            wq = np.clip(np.round(w / s_w), -127, 127).astype(np.int8)
+            s_in = max(stats[f"{branch}/{i}"], 1e-12) / 127.0
+            qconvs.append(
+                {
+                    "wq": jnp.asarray(wq),
+                    "bias": jnp.asarray(layer["bias"], jnp.float32),
+                    "s_in": jnp.float32(s_in),
+                    "rescale": jnp.asarray(s_in * s_w, jnp.float32),
+                }
+            )
+        qtree[branch] = qconvs
+    return qtree
+
+
+def quant_forward(qtree, x, wb, ce, gc):
+    """int8 inference forward; jit this (or let InferenceEngine do it)."""
+    return _forward(qtree, x, wb, ce, gc, _conv_int8)
