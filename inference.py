@@ -95,6 +95,14 @@ def parse_args(argv=None):
         help="(Optional) Static int8 inference (MXU double-rate path; "
         "typically >40 dB PSNR vs the float forward).",
     )
+    parser.add_argument(
+        "--data-shards",
+        type=int,
+        default=1,
+        help="(Optional) Shard each frame batch over N devices (video "
+        "throughput scale-out; batches pad to a multiple of N, so use a "
+        "--batch-size that is one for full utilization).",
+    )
     return parser.parse_args(argv)
 
 
@@ -245,6 +253,7 @@ def main(argv=None):
         device_preprocess=args.device_preprocess,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
         spatial_shards=args.spatial_shards,
+        data_shards=args.data_shards,
         quantize=args.quantize,
         # Calibrate int8 activation scales on the ACTUAL inputs (not the
         # synthetic defaults) so out-of-range activations aren't clipped.

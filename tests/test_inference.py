@@ -40,6 +40,49 @@ def test_engine_device_vs_host_preprocess_close(random_params, sample_rgb):
     assert np.abs(a - b).mean() < 3.0
 
 
+def test_engine_data_sharded_matches_single_device(random_params, sample_rgb):
+    """Batch sharded over 4 of the virtual devices == unsharded output
+    (params replicated, no collectives in the forward)."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    frames = np.stack([sample_rgb] * 4)
+    frames[1] = frames[1][::-1]  # make the shards distinguishable
+    single = InferenceEngine(params=random_params, device_preprocess=True)
+    sharded = InferenceEngine(
+        params=random_params, device_preprocess=True, data_shards=4
+    )
+    np.testing.assert_array_equal(
+        single.enhance(frames), sharded.enhance(frames)
+    )
+    # Non-multiple batches pad transparently (last frame repeated) and
+    # strip back to the real count.
+    np.testing.assert_array_equal(
+        single.enhance(frames[:3]), sharded.enhance(frames[:3])
+    )
+
+
+def test_engine_data_sharded_quantized(random_params, sample_rgb):
+    """data_shards composes with the int8 path."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    frames = np.stack([sample_rgb] * 2)
+    q1 = InferenceEngine(
+        params=random_params, device_preprocess=True, quantize=True
+    )
+    q2 = InferenceEngine(
+        params=random_params, device_preprocess=True, quantize=True,
+        data_shards=2,
+    )
+    np.testing.assert_array_equal(q1.enhance(frames), q2.enhance(frames))
+
+
+def test_engine_data_and_spatial_shards_rejected(random_params):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        InferenceEngine(params=random_params, data_shards=2, spatial_shards=2)
+
+
 def test_hub_triple_contract(random_params, sample_rgb, tmp_path, monkeypatch):
     from waternet_tpu.hub import waternet
     from waternet_tpu.utils.checkpoint import save_weights

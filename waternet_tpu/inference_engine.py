@@ -35,6 +35,7 @@ class InferenceEngine:
         device_preprocess: bool = False,
         dtype=jnp.float32,
         spatial_shards: int = 1,
+        data_shards: int = 1,
         quantize: bool = False,
         calib_batches=None,
     ):
@@ -43,6 +44,14 @@ class InferenceEngine:
         — for frames too large for one chip's HBM. Requires
         ``spatial_shards`` devices and H divisible by it with slabs >= 26
         rows.
+
+        ``data_shards > 1`` shards the FRAME BATCH over that many devices
+        (params replicated, XLA moves the shards; no collectives in the
+        forward) — the throughput scale-out for video on a pod slice.
+        Non-multiple batches pad transparently (last frame repeated), so
+        send multiples of ``data_shards`` for full utilization. Composes
+        with ``quantize`` and ``device_preprocess``; mutually exclusive
+        with ``spatial_shards`` for now.
 
         ``quantize=True`` converts the checkpoint to static int8 at
         construction (see :mod:`waternet_tpu.models.quant`): int8 x int8
@@ -68,11 +77,25 @@ class InferenceEngine:
         self.quantized = quantize
 
         self.spatial_shards = spatial_shards
+        self.data_shards = data_shards
         if quantize and spatial_shards > 1:
             raise ValueError(
                 "quantize=True with spatial_shards > 1 is not supported yet "
                 "(the halo-exchange path runs the float module)"
             )
+        if data_shards > 1 and spatial_shards > 1:
+            raise ValueError(
+                "data_shards and spatial_shards are mutually exclusive for "
+                "now; pick batch scale-out OR single-frame decomposition"
+            )
+        if quantize:
+            from waternet_tpu.models.quant import quant_forward, quantize_waternet
+
+            # quant_forward(qtree, x, wb, ce, gc) has the same signature
+            # shape as module.apply(params, ...), so the qtree simply
+            # replaces the params for every downstream path.
+            self.params = quantize_waternet(params, calib_batches)
+
         if spatial_shards > 1:
             from waternet_tpu.parallel.mesh import make_mesh
             from waternet_tpu.parallel.spatial import spatial_sharded_apply
@@ -80,16 +103,30 @@ class InferenceEngine:
             mesh = make_mesh(n_data=1, n_spatial=spatial_shards)
             # Already jitted; do not wrap in another jax.jit layer.
             _forward = spatial_sharded_apply(self.module, mesh)
-        elif quantize:
-            from waternet_tpu.models.quant import quant_forward, quantize_waternet
-
-            # quant_forward(qtree, x, wb, ce, gc) has the same signature
-            # shape as module.apply(params, ...), so the qtree simply
-            # replaces the params for every downstream path.
-            self.params = quantize_waternet(params, calib_batches)
-            _forward = jax.jit(quant_forward)
         else:
-            _forward = jax.jit(self.module.apply)
+            if quantize:
+                from waternet_tpu.models.quant import quant_forward
+
+                apply_fn = quant_forward
+            else:
+                apply_fn = self.module.apply
+            if data_shards > 1:
+                from waternet_tpu.parallel.mesh import (
+                    batch_sharding,
+                    make_mesh,
+                    replicated,
+                )
+
+                mesh = make_mesh(n_data=data_shards, n_spatial=1)
+                bsh = batch_sharding(mesh)
+                rep = replicated(mesh)
+                _forward = jax.jit(
+                    apply_fn,
+                    in_shardings=(rep, bsh, bsh, bsh, bsh),
+                    out_shardings=bsh,
+                )
+            else:
+                _forward = jax.jit(apply_fn)
 
         def _fused(p, rgb_u8):
             """uint8 batch -> enhanced float batch, preprocessing on device."""
@@ -98,7 +135,28 @@ class InferenceEngine:
             return _forward(p, rgb, wb / 255.0, he / 255.0, gc / 255.0)
 
         self._forward = _forward
-        self._fused = jax.jit(_fused)
+        if data_shards > 1:
+            # Shard the raw uint8 batch at the boundary so preprocessing
+            # runs shard-local too (no resharding between stages).
+            self._fused = jax.jit(
+                _fused, in_shardings=(rep, bsh), out_shardings=bsh
+            )
+        else:
+            self._fused = jax.jit(_fused)
+
+    def _pad_for_shards(self, rgb_batch):
+        """-> (padded_batch, n_real). Shards need equal batch slices, so a
+        batch that isn't a multiple of data_shards is padded by repeating
+        the last frame (throughput-optimal callers send full multiples; the
+        video CLI already pads whole clips to one compile shape). Leaves
+        device arrays untouched on the fast path — enhance_async must not
+        force a host round-trip."""
+        n = rgb_batch.shape[0]
+        if self.data_shards <= 1 or n % self.data_shards == 0:
+            return rgb_batch, n
+        from waternet_tpu.parallel.mesh import pad_to_multiple
+
+        return pad_to_multiple(np.asarray(rgb_batch), self.data_shards)
 
     def _validate_shape(self, rgb_batch) -> None:
         if self.spatial_shards <= 1:
@@ -129,10 +187,14 @@ class InferenceEngine:
         :func:`waternet_tpu.utils.tensor.ten2arr` on the result to sync.
         """
         self._validate_shape(rgb_batch)
+        rgb_batch, n_real = self._pad_for_shards(rgb_batch)
         if self.device_preprocess:
-            return self._fused(self.params, jnp.asarray(rgb_batch))
-        wb, gc, he = zip(*(transform_np(f) for f in rgb_batch))
-        to_dev = lambda arrs: jnp.asarray(np.stack(arrs), jnp.float32) / 255.0
-        return self._forward(
-            self.params, to_dev(list(rgb_batch)), to_dev(wb), to_dev(he), to_dev(gc)
-        )
+            out = self._fused(self.params, jnp.asarray(rgb_batch))
+        else:
+            wb, gc, he = zip(*(transform_np(f) for f in rgb_batch))
+            to_dev = lambda arrs: jnp.asarray(np.stack(arrs), jnp.float32) / 255.0
+            out = self._forward(
+                self.params, to_dev(list(rgb_batch)), to_dev(wb), to_dev(he),
+                to_dev(gc),
+            )
+        return out[:n_real]
