@@ -108,9 +108,23 @@ def test_inference_engine_quantized(setup):
     assert np.mean(np.abs(out_q.astype(int) - out_f.astype(int))) < 2.0
 
 
-def test_quantize_with_spatial_shards_rejected(setup):
+def test_quantized_spatial_sharded_matches_unsharded(setup):
+    """int8 + halo-exchange H-sharding: the quantize/rescale steps are
+    pointwise, so windowed slabs reproduce the unsharded int8 forward."""
     from waternet_tpu.inference_engine import InferenceEngine
 
-    _, params, _ = setup
-    with pytest.raises(ValueError, match="spatial_shards"):
-        InferenceEngine(params=params, quantize=True, spatial_shards=2)
+    _, params, calib = setup
+    rng = np.random.default_rng(0)
+    # H=64 over 2 shards -> 32-row slabs >= 2*HALO=26.
+    frames = rng.integers(0, 256, (1, 64, 48, 3), dtype=np.uint8)
+    q1 = InferenceEngine(
+        params=params, device_preprocess=True, quantize=True,
+        calib_batches=calib,
+    )
+    q2 = InferenceEngine(
+        params=params, device_preprocess=True, quantize=True,
+        calib_batches=calib, spatial_shards=2,
+    )
+    a = q1.enhance(frames)[0].astype(int)
+    b = q2.enhance(frames)[0].astype(int)
+    assert np.abs(a - b).max() <= 1  # float-rescale associativity only

@@ -78,11 +78,6 @@ class InferenceEngine:
 
         self.spatial_shards = spatial_shards
         self.data_shards = data_shards
-        if quantize and spatial_shards > 1:
-            raise ValueError(
-                "quantize=True with spatial_shards > 1 is not supported yet "
-                "(the halo-exchange path runs the float module)"
-            )
         if data_shards > 1 and spatial_shards > 1:
             raise ValueError(
                 "data_shards and spatial_shards are mutually exclusive for "
@@ -95,21 +90,20 @@ class InferenceEngine:
             # shape as module.apply(params, ...), so the qtree simply
             # replaces the params for every downstream path.
             self.params = quantize_waternet(params, calib_batches)
+            apply_fn = quant_forward
+        else:
+            apply_fn = self.module.apply
 
         if spatial_shards > 1:
             from waternet_tpu.parallel.mesh import make_mesh
             from waternet_tpu.parallel.spatial import spatial_sharded_apply
 
             mesh = make_mesh(n_data=1, n_spatial=spatial_shards)
-            # Already jitted; do not wrap in another jax.jit layer.
-            _forward = spatial_sharded_apply(self.module, mesh)
+            # Already jitted; do not wrap in another jax.jit layer. The
+            # halo-exchange path takes the same functional forward the
+            # single-device path uses (float or int8).
+            _forward = spatial_sharded_apply(apply_fn, mesh)
         else:
-            if quantize:
-                from waternet_tpu.models.quant import quant_forward
-
-                apply_fn = quant_forward
-            else:
-                apply_fn = self.module.apply
             if data_shards > 1:
                 from waternet_tpu.parallel.mesh import (
                     batch_sharding,

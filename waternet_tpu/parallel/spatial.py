@@ -55,17 +55,23 @@ HALO = 13
 def spatial_sharded_apply(module, mesh: Mesh):
     """Build a jitted forward running H-sharded over ``mesh``'s spatial axis.
 
+    ``module`` is a Flax module (its ``.apply`` is used) or any callable
+    ``fn(params, x, wb, ce, gc) -> out`` with the WaterNet receptive field —
+    e.g. the int8 :func:`waternet_tpu.models.quant.quant_forward`, whose
+    quantize/rescale steps are pointwise and so commute with the windowing.
+
     Returns ``fn(params, x, wb, ce, gc) -> out`` operating on full (global)
-    NHWC arrays; H must divide the spatial axis size and each slab must have
-    at least ``2 * HALO`` rows.
+    NHWC arrays; the spatial axis size (n_shards) must divide H and each
+    slab must have at least ``2 * HALO`` rows.
     """
+    apply_fn = module.apply if hasattr(module, "apply") else module
     n_shards = mesh.shape[SPATIAL_AXIS]
     img_spec = P(None, SPATIAL_AXIS, None, None)
     k2 = 2 * HALO
 
     if n_shards == 1:
         def unsharded(params, x, wb, ce, gc):
-            return module.apply(params, x, wb, ce, gc)
+            return apply_fn(params, x, wb, ce, gc)
 
         return jax.jit(unsharded)
 
@@ -87,7 +93,7 @@ def spatial_sharded_apply(module, mesh: Mesh):
             c = jnp.concatenate([recv_top, t, recv_bot], axis=1)
             return lax.dynamic_slice_in_dim(c, start, slab + k2, axis=1)
 
-        out = module.apply(params, window(x), window(wb), window(ce), window(gc))
+        out = apply_fn(params, window(x), window(wb), window(ce), window(gc))
         return lax.dynamic_slice_in_dim(out, k2 - start, slab, axis=1)
 
     sharded = shard_map(
