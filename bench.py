@@ -133,13 +133,16 @@ def bench_video(hw=(1080, 1920), batch=4, steps=12, quantize=None):
 
 
 def measure_train(
-    batch=None, hw=None, precision=None, warmup=None, steps=None
+    batch=None, hw=None, precision=None, warmup=None, steps=None,
+    **config_overrides,
 ):
     """The headline measurement: one fused train step (on-device augment +
     WB/GC/CLAHE + WaterNet + VGG fwd/bwd + Adam + metrics), AOT-compiled
     once, steady-state timed. Returns the JSON-line dict (the CLI prints
     it). Module-level env defaults apply when args are None so the CLI and
-    library callers (tools/tpu_session.py) share one code path."""
+    library callers (tools/tpu_session.py, tools/host_bench.py) share one
+    code path; extra kwargs pass through to TrainConfig (e.g.
+    ``perceptual_weight=0.0`` for a no-VGG arm)."""
     batch = BATCH if batch is None else batch
     hw = HW if hw is None else hw
     precision = PRECISION if precision is None else precision
@@ -150,7 +153,8 @@ def measure_train(
     from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
 
     config = TrainConfig(
-        batch_size=batch, im_height=hw, im_width=hw, precision=precision
+        batch_size=batch, im_height=hw, im_width=hw, precision=precision,
+        **config_overrides,
     )
     engine = TrainingEngine(config)
 

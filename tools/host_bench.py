@@ -180,43 +180,19 @@ def bench_reference_train_step(hw: int, batch: int, steps: int):
 def bench_our_train_step(hw: int, batch: int, steps: int):
     """Our fused jitted step on the CPU backend, perceptual OFF to match
     the reference arm; includes the on-device WB/GC/CLAHE preprocessing
-    the reference arm pays for on the host side."""
-    import jax
-    import jax.numpy as jnp
+    the reference arm pays for on the host side. Delegates to
+    bench.measure_train — the same AOT-compile/warmup/measure loop the
+    headline benchmark uses."""
+    from bench import measure_train
 
-    from waternet_tpu.data.synthetic import SyntheticPairs
-    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
-
-    config = TrainConfig(
-        batch_size=batch, im_height=hw, im_width=hw,
-        precision="fp32", perceptual_weight=0.0, augment=False,
+    line = measure_train(
+        batch=batch, hw=hw, precision="fp32", warmup=1, steps=steps,
+        perceptual_weight=0.0, augment=False,
     )
-    engine = TrainingEngine(config)
-    data = SyntheticPairs(batch, hw, hw, seed=0)
-    raw, ref = next(
-        iter(data.batches(np.arange(batch), batch, shuffle=False))
-    )
-    raw_d, ref_d = jnp.asarray(raw), jnp.asarray(ref)
-    rng = jax.random.PRNGKey(0)
-    n_real = jnp.asarray(batch, jnp.int32)
-
-    t0 = time.perf_counter()
-    compiled = engine.train_step.lower(
-        engine.state, raw_d, ref_d, rng, n_real
-    ).compile()
-    compile_s = time.perf_counter() - t0
-    state = engine.state
-    state, m = compiled(state, raw_d, ref_d, rng, n_real)  # warmup
-    jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = compiled(state, raw_d, ref_d, rng, n_real)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
     return {
-        "images_per_sec": round(batch * steps / dt, 2),
-        "step_ms": round(dt / steps * 1e3, 1),
-        "compile_sec": round(compile_s, 1),
+        "images_per_sec": line["value"],
+        "step_ms": line["step_ms"],
+        "compile_sec": line["compile_sec"],
     }
 
 
