@@ -1,0 +1,65 @@
+"""Tests for StableHLO deployment artifacts (waternet_tpu/export.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from waternet_tpu.export import load_artifact, save_artifact
+from waternet_tpu.models import WaterNet
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model = WaterNet()
+    x0 = jnp.ones((1, 32, 32, 3)) * 0.5
+    params = model.init(jax.random.PRNGKey(0), x0, x0, x0, x0)
+    return model, params
+
+
+def test_artifact_shape_polymorphic_roundtrip(setup, tmp_path):
+    """ONE serialized artifact serves multiple (batch, H, W) — the FCN
+    property carried into the deployment form."""
+    model, params = setup
+    path = save_artifact(tmp_path / "wn", params)
+    assert path.suffix == ".stablehlo" and path.stat().st_size > 0
+    # Lowered for both platforms even though this host is CPU-only.
+    from jax import export as jexport
+
+    assert set(jexport.deserialize(path.read_bytes()).platforms) == {
+        "cpu", "tpu"
+    }
+    run = load_artifact(path)
+    rng = np.random.default_rng(0)
+    for shape in [(1, 48, 48), (2, 64, 96)]:
+        xs = [jnp.asarray(rng.random(shape + (3,), np.float32)) for _ in range(4)]
+        want = np.asarray(model.apply(params, *xs))
+        got = np.asarray(run(*xs))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_artifact_int8_variant(setup, tmp_path):
+    """Quantized artifact: ~4x smaller, within the int8 PSNR budget."""
+    from waternet_tpu.models.quant import default_calibration_inputs
+
+    model, params = setup
+    calib = default_calibration_inputs(n=2, hw=48)
+    p_f = save_artifact(tmp_path / "f", params)
+    p_q = save_artifact(
+        tmp_path / "q", params, quantize=True, calib_batches=calib
+    )
+    assert p_q.stat().st_size < p_f.stat().st_size / 2
+    run = load_artifact(p_q)
+    rng = np.random.default_rng(1)
+    xs = [jnp.asarray(rng.random((1, 48, 48, 3), np.float32)) for _ in range(4)]
+    want = np.asarray(model.apply(params, *xs))
+    got = np.asarray(run(*xs))
+    err = float(np.mean((want - got) ** 2))
+    peak = float(np.max(np.abs(want))) or 1.0
+    assert 10 * np.log10(peak**2 / err) > 33.0
+
+
+def test_calib_without_quantize_rejected(setup, tmp_path):
+    _, params = setup
+    with pytest.raises(ValueError, match="quantize=True"):
+        save_artifact(tmp_path / "x", params, calib_batches=[])

@@ -1,0 +1,106 @@
+"""Serialized deployment artifacts for the inference forward (StableHLO).
+
+The reference's only deployment story is "install torch and load the
+checkpoint" (`/root/reference/hubconf.py:37-96`). A TPU-native framework can
+do better: ``jax.export`` serializes the traced forward — weights baked in —
+as a portable StableHLO artifact that any later JAX runtime (or anything
+else that consumes StableHLO) can execute without this package, its Python
+code, or the original checkpoint format.
+
+Properties:
+
+* **Shape-polymorphic**: exported with symbolic (batch, H, W), so ONE
+  artifact serves every resolution — the FCN property
+  (`/root/reference/waternet/net.py:84-90`) carried into the serialized
+  form. 112x112 training crops and 1080p video frames run from the same
+  file.
+* **Self-contained**: params (float or the int8 qtree) are constants inside
+  the artifact.
+* **int8-exportable**: pass ``quantize=True`` to bake the statically
+  calibrated int8 forward (see :mod:`waternet_tpu.models.quant`).
+
+The artifact covers the MODEL forward ``(x, wb, ce, gc) -> out`` — the hub
+triple's ``model`` leg. Preprocessing (WB/GC/CLAHE) stays a runtime choice
+(host cv2 parity path vs on-device fused path), exactly as in the live API.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+from jax import export as jexport
+
+from waternet_tpu.models import WaterNet
+
+_MAGIC_SUFFIX = ".stablehlo"
+
+
+def export_forward(
+    params,
+    *,
+    quantize: bool = False,
+    calib_batches=None,
+    dtype=jnp.float32,
+    platforms=("cpu", "tpu"),
+):
+    """-> jax.export.Exported of ``(x, wb, ce, gc) -> out`` with symbolic
+    (batch, height, width) and params baked in as constants.
+
+    ``platforms`` controls which backends the artifact is lowered for
+    (default: cpu AND tpu, so one file exported anywhere runs on both)."""
+    if calib_batches is not None and not quantize:
+        raise ValueError(
+            "calib_batches given without quantize=True — the calibration "
+            "data would be silently dropped from a float artifact"
+        )
+    if quantize:
+        from waternet_tpu.models.quant import quant_forward, quantize_waternet
+
+        qtree = quantize_waternet(params, calib_batches)
+
+        def fn(x, wb, ce, gc):
+            return quant_forward(qtree, x, wb, ce, gc)
+
+    else:
+        module = WaterNet(dtype=dtype)
+
+        def fn(x, wb, ce, gc):
+            return module.apply(params, x, wb, ce, gc)
+
+    b, h, w = jexport.symbolic_shape("b, h, w")
+    spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+    return jexport.export(jax.jit(fn), platforms=list(platforms))(
+        spec, spec, spec, spec
+    )
+
+
+def save_artifact(path, params, **kwargs) -> Path:
+    """Export and serialize to ``path`` (``.stablehlo`` appended if no
+    suffix). Returns the written path."""
+    path = Path(path)
+    if not path.suffix:
+        path = path.with_suffix(_MAGIC_SUFFIX)
+    exported = export_forward(params, **kwargs)
+    path.write_bytes(exported.serialize())
+    return path
+
+
+def load_artifact(path):
+    """-> callable ``(x, wb, ce, gc) -> out`` from a serialized artifact.
+
+    The returned callable jit-executes the embedded StableHLO; it needs only
+    jax at runtime (no waternet_tpu, no checkpoint file).
+    """
+    exported = jexport.deserialize(Path(path).read_bytes())
+
+    def run(x, wb, ce, gc):
+        return exported.call(
+            jnp.asarray(x, jnp.float32),
+            jnp.asarray(wb, jnp.float32),
+            jnp.asarray(ce, jnp.float32),
+            jnp.asarray(gc, jnp.float32),
+        )
+
+    return run
