@@ -1,0 +1,102 @@
+"""One-shot watcher: resume the TPU measurement session when the relay returns.
+
+The accelerator tunnel dies and (sometimes) comes back within a session. This
+watcher polls PASSIVELY (/proc/net/tcp, no connections) for the relay's LISTEN
+ports; after they have been up for a stabilization window with no other client
+holding an ESTABLISHED connection into the relay port range, it launches ONE
+``tools/tpu_session.py --resume`` run and exits. Completed stages carry over;
+the resume run is configured to skip the already-measured video sweep and the
+relay-killing gather variant (see tpu_session.AB_VARIANTS).
+
+Usage::
+
+    python tools/relay_watch.py [--poll 30] [--stable 30] [--max-hours 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Primary relay listen port; keep in sync with bench._relay_listening.
+RELAY_PORT = int(os.environ.get("WATERNET_RELAY_PORT", "8082"))
+
+
+def _tcp_states():
+    """[(local_port, remote_port, state_hex)] from /proc/net/tcp{,6}."""
+    out = []
+    for f in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            lines = Path(f).read_text().splitlines()[1:]
+        except OSError:
+            continue
+        for ln in lines:
+            p = ln.split()
+            if len(p) > 3:
+                out.append(
+                    (
+                        int(p[1].split(":")[1], 16),
+                        int(p[2].split(":")[1], 16),
+                        p[3],
+                    )
+                )
+    return out
+
+
+def relay_listening() -> bool:
+    return any(lp == RELAY_PORT and st == "0A" for lp, _, st in _tcp_states())
+
+
+def relay_busy() -> bool:
+    """True if a client already holds a connection to the relay port itself
+    (both sides of a loopback connection appear, so check local+remote)."""
+    return any(
+        st == "01" and RELAY_PORT in (lp, rp) for lp, rp, st in _tcp_states()
+    )
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--poll", type=float, default=30.0)
+    p.add_argument("--stable", type=float, default=30.0)
+    p.add_argument("--max-hours", type=float, default=10.0)
+    p.add_argument(
+        "--session-args",
+        default="--resume --skip-video "
+        "--ab-variants all-except:clahe_interp_gather",
+    )
+    args = p.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    log = lambda m: print(f"[relay_watch] {m}", file=sys.stderr, flush=True)
+    log(f"watching for relay LISTEN on :{RELAY_PORT} (passive)")
+    while time.time() < deadline:
+        if relay_listening():
+            log(f"relay up; stabilizing {args.stable:.0f}s")
+            time.sleep(args.stable)
+            if not relay_listening():
+                log("relay went away during stabilization; rearming")
+                continue
+            if relay_busy():
+                log("another client holds the relay; deferring")
+                time.sleep(args.poll)
+                continue
+            cmd = [sys.executable, str(REPO / "tools" / "tpu_session.py")]
+            cmd += args.session_args.split()
+            log(f"launching: {' '.join(cmd)}")
+            rc = subprocess.call(cmd, cwd=str(REPO))
+            log(f"tpu_session exited rc={rc}; watcher done")
+            return rc
+        time.sleep(args.poll)
+    log("deadline reached without a live relay; giving up")
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

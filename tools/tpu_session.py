@@ -44,23 +44,43 @@ sys.path.insert(0, str(REPO))
 AB_VARIANTS = [
     # (name, env overrides) — fresh TrainingEngine per variant re-traces, so
     # trace-time env reads (ops/clahe._hist_mode/_interp_mode) take effect.
-    ("clahe_interp_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
-    ("clahe_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
-    ("clahe_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter"}),
-    ("clahe_hist_matmul", {"WATERNET_CLAHE_HIST": "matmul"}),
-    ("clahe_hist_pallas", {"WATERNET_CLAHE_HIST": "pallas"}),
+    # Ordered safest-first: the gather/scatter lowerings wedged the remote
+    # XLA compile service for >30 min on the real chip (2026-07-29 session),
+    # so they run LAST — a wedge then costs nothing already measured.
     ("fp32", {"_precision": "fp32"}),
+    ("clahe_hist_pallas", {"WATERNET_CLAHE_HIST": "pallas"}),
+    ("clahe_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
+    ("clahe_hist_matmul", {"WATERNET_CLAHE_HIST": "matmul"}),
+    ("clahe_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter"}),
+    ("clahe_interp_gather", {"WATERNET_CLAHE_INTERP": "gather"}),
 ]
 
 
 class _Session:
-    def __init__(self, out_path: Path):
+    def __init__(self, out_path: Path, resume: bool = False):
         self.out_path = out_path
         self.report = {
             "started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "out_name": out_path.name,
             "stages": {},
         }
+        if resume and out_path.exists():
+            try:
+                prev = json.loads(out_path.read_text())
+                carried = prev.get("stages", {})
+                # Always re-run init: its liveness probe must reflect THIS
+                # run's tunnel, not the run that died.
+                carried.pop("init", None)
+                self.report["stages"] = carried
+                self.report["resumed_from_utc"] = prev.get("started_utc")
+                n_ok = sum(1 for v in self.report["stages"].values() if v.get("ok"))
+                print(
+                    f"[tpu_session] resuming: {n_ok} completed stage(s) carried"
+                    f" over from {out_path}",
+                    file=sys.stderr,
+                )
+            except Exception as e:
+                print(f"[tpu_session] resume load failed: {e}", file=sys.stderr)
 
     def save(self) -> None:
         self.out_path.parent.mkdir(parents=True, exist_ok=True)
@@ -72,6 +92,14 @@ class _Session:
             print(f"[tpu_session] markdown render failed: {e}", file=sys.stderr)
 
     def run_stage(self, name: str, fn):
+        prev = self.report["stages"].get(name)
+        if prev and prev.get("ok"):
+            print(
+                f"[tpu_session] {name}: already measured (resume), skipping",
+                file=sys.stderr,
+                flush=True,
+            )
+            return prev
         print(f"[tpu_session] stage: {name}", file=sys.stderr, flush=True)
         t0 = time.perf_counter()
         try:
@@ -329,8 +357,25 @@ def stage_convergence(epochs: int, out_csv: Path, hw: int = 112, batch: int = 16
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", default=str(REPO / "docs" / "tpu_session.json"))
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="carry over completed stages from an existing --out file "
+        "(for restarting after a wedged stage was killed externally)",
+    )
     p.add_argument("--skip-video", action="store_true")
     p.add_argument("--skip-ab", action="store_true")
+    p.add_argument(
+        "--ab-variants",
+        default="all",
+        help="'all', a comma list of AB_VARIANTS names, or "
+        "'all-except:<comma list>'. Unknown names are an error (a typo "
+        "must not silently skip the sweep). The 2026-07-29 session proved "
+        "clahe_interp_gather's TPU lowering wedges (and then kills) the "
+        "remote-compile relay, so resume runs should use "
+        "'all-except:clahe_interp_gather': its recorded failure IS the "
+        "A/B outcome.",
+    )
     p.add_argument("--skip-profile", action="store_true")
     p.add_argument("--convergence-epochs", type=int, default=40)
     p.add_argument(
@@ -348,6 +393,25 @@ def main():
     )
     args = p.parse_args()
 
+    # Validate the A/B selection BEFORE any stage runs: a typo must fail
+    # fast, not surface as a silently-empty sweep after an hour of benches.
+    known = {name for name, _ in AB_VARIANTS}
+    spec = args.ab_variants
+    if spec == "all":
+        wanted_ab = known
+    else:
+        exclude = spec.startswith("all-except:")
+        names = {
+            v.strip() for v in spec.split(":", 1)[-1].split(",") if v.strip()
+        }
+        unknown = names - known
+        if unknown:
+            p.error(
+                f"--ab-variants: unknown variant(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+        wanted_ab = known - names if exclude else names
+
     import bench
     from waternet_tpu.utils.platform import enable_compile_cache, ensure_platform
 
@@ -361,7 +425,7 @@ def main():
     ensure_platform()
     enable_compile_cache()
 
-    s = _Session(Path(args.out))
+    s = _Session(Path(args.out), resume=args.resume)
     s.run_stage("init", stage_init)
     if not s.report["stages"]["init"]["ok"]:
         print(json.dumps(s.report))
@@ -394,25 +458,9 @@ def main():
             ),
         )
 
-    if not args.skip_ab:
-        for name, overrides in AB_VARIANTS:
-            precision = overrides.get("_precision", "bf16")
-            env = {k: v for k, v in overrides.items() if not k.startswith("_")}
-            undo = _env_patch(env)
-            try:
-                s.run_stage(
-                    f"ab_{name}",
-                    lambda: bench.measure_train(
-                        batch=args.batch,
-                        hw=args.hw,
-                        precision=precision,
-                        warmup=2,
-                        steps=args.train_steps,
-                    ),
-                )
-            finally:
-                undo()
-
+    # Profile + convergence BEFORE the A/B sweep: the sweep's exotic
+    # lowerings (gather/scatter) have wedged the remote compile service on
+    # the real chip, and everything after a wedge is lost.
     if not args.skip_profile:
         s.run_stage(
             "profile",
@@ -431,6 +479,27 @@ def main():
                 batch=args.batch,
             ),
         )
+
+    if not args.skip_ab:
+        for name, overrides in AB_VARIANTS:
+            if name not in wanted_ab:
+                continue
+            precision = overrides.get("_precision", "bf16")
+            env = {k: v for k, v in overrides.items() if not k.startswith("_")}
+            undo = _env_patch(env)
+            try:
+                s.run_stage(
+                    f"ab_{name}",
+                    lambda: bench.measure_train(
+                        batch=args.batch,
+                        hw=args.hw,
+                        precision=precision,
+                        warmup=2,
+                        steps=args.train_steps,
+                    ),
+                )
+            finally:
+                undo()
 
     s.report["finished_utc"] = time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
