@@ -54,10 +54,23 @@ def relay_listening() -> bool:
 
 
 def relay_busy() -> bool:
-    """True if a client already holds a connection to the relay port itself
-    (both sides of a loopback connection appear, so check local+remote)."""
+    """True if a client holds a connection into the relay STACK — not just
+    the primary port. The tunnel spans a grid of services (observed LISTEN
+    set: 8082/83/87, 8092/93/97, ... 8112/13/117; the recorded session
+    death involved the compile service on :8103 and a device connection on
+    :8113), so a client can be mid-compile with no :8082 connection at all.
+    Busy = any ESTABLISHED connection whose endpoint is a port the relay
+    stack currently LISTENs on (ports near RELAY_PORT), which excludes
+    unrelated services outside that window."""
+    states = _tcp_states()
+    stack_ports = {
+        lp
+        for lp, _, st in states
+        if st == "0A" and RELAY_PORT - 2 <= lp < RELAY_PORT + 38
+    }
     return any(
-        st == "01" and RELAY_PORT in (lp, rp) for lp, rp, st in _tcp_states()
+        st == "01" and (lp in stack_ports or rp in stack_ports)
+        for lp, rp, st in states
     )
 
 

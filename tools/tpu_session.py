@@ -180,6 +180,34 @@ def _render_markdown(report) -> str:
                 f"{v['frame_ms']} |"
             )
         lines.append("")
+    link = stages.get("link_bandwidth")
+    if link and link.get("ok"):
+        lines += [
+            f"Host<->device link: {link['h2d_MB_per_s']} MB/s up, "
+            f"{link['d2h_MB_per_s']} MB/s down "
+            f"({link['payload_mb']} MB incompressible payload) — on an axon "
+            "tunnel this is the relay, not PCIe; it bounds the end-to-end "
+            "video numbers above.",
+            "",
+        ]
+    pre = stages.get("preprocess_breakdown")
+    if pre and pre.get("ok"):
+        lines += [
+            f"## Classical-preprocessing breakdown ({pre['hw']}x{pre['hw']}, "
+            f"batch {pre['batch']}, standalone jits)",
+            "",
+            f"- white balance {pre['wb_ms']} ms | gamma {pre['gamma_ms']} ms "
+            f"| CLAHE histeq {pre['histeq_ms']} ms | full (wb,gc,he) "
+            f"transform {pre['transform_all_ms']} ms",
+            "",
+        ]
+    b64 = stages.get("train_bf16_batch64")
+    if b64 and b64.get("ok"):
+        lines += [
+            f"Throughput-optimal batch 64: **{b64['value']} images/sec/chip** "
+            f"(step {b64['step_ms']} ms, MFU {b64['mfu']}).",
+            "",
+        ]
     ab = [(k, v) for k, v in stages.items() if k.startswith("ab_") and v.get("ok")]
     if ab:
         lines += [
@@ -366,6 +394,12 @@ def main():
     p.add_argument("--skip-video", action="store_true")
     p.add_argument("--skip-ab", action="store_true")
     p.add_argument(
+        "--skip-micro",
+        action="store_true",
+        help="skip link-bandwidth / preprocess-breakdown / device-resident "
+        "video / batch-64 micro-measurements",
+    )
+    p.add_argument(
         "--ab-variants",
         default="all",
         help="'all', a comma list of AB_VARIANTS names, or "
@@ -409,6 +443,14 @@ def main():
             p.error(
                 f"--ab-variants: unknown variant(s) {sorted(unknown)}; "
                 f"known: {sorted(known)}"
+            )
+        if not names:
+            # 'all-except:' with a forgotten name would silently run ALL
+            # variants (including the relay-killer); '' would silently run
+            # none. Both are operator mistakes — refuse.
+            p.error(
+                "--ab-variants: empty selection; pass 'all', names, or "
+                "'all-except:<names>'"
             )
         wanted_ab = known - names if exclude else names
 
@@ -477,6 +519,41 @@ def main():
                 REPO / "docs" / "convergence_tpu.csv",
                 hw=args.hw,
                 batch=args.batch,
+            ),
+        )
+
+    # Cheap, high-information micro-measurements (run even under
+    # --skip-video: that flag skips the tunnel-transfer-bound end-to-end
+    # sweep, while these move almost nothing over the link).
+    if not args.skip_micro:
+        s.run_stage("link_bandwidth", lambda: bench.measure_link_bandwidth())
+        s.run_stage(
+            "preprocess_breakdown",
+            lambda: bench.measure_preprocess_breakdown(
+                batch=args.batch, hw=args.hw, steps=args.train_steps
+            ),
+        )
+        vh = args.video_height
+        s.run_stage(
+            f"video_{vh}p_device_resident",
+            lambda: bench.bench_video_device_resident(
+                hw=(vh, vh * 16 // 9), batch=4, steps=12
+            ),
+        )
+        s.run_stage(
+            f"video_{vh}p_device_resident_int8",
+            lambda: bench.bench_video_device_resident(
+                hw=(vh, vh * 16 // 9), batch=4, steps=12, quantize=True
+            ),
+        )
+        # Throughput-optimal batch: the reference-parity headline is batch
+        # 16; one larger-batch point shows what the chip does when not
+        # latency-matched to the reference config.
+        s.run_stage(
+            "train_bf16_batch64",
+            lambda: bench.measure_train(
+                batch=64, hw=args.hw, precision="bf16", warmup=2,
+                steps=args.train_steps,
             ),
         )
 
