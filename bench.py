@@ -475,6 +475,31 @@ def _run_benchmark_child(timeout_s: int):
     return None
 
 
+def _last_measured_headline():
+    """The train_bf16 result from the most recent tools/tpu_session.py run
+    on a real TPU (docs/tpu_session.json), or None. Used to annotate a
+    failed bench line — measured evidence shouldn't vanish because the
+    fragile tunnel is down at harvest time."""
+    try:
+        with open(
+            os.path.join(os.path.dirname(__file__), "docs", "tpu_session.json")
+        ) as f:
+            report = json.load(f)
+        entry = report["stages"]["train_bf16"]
+        if not entry.get("ok") or "tpu" not in entry.get("device_kind", "").lower():
+            return None
+        keep = (
+            "value", "unit", "vs_baseline", "step_ms", "preprocess_ms",
+            "model_tflop_per_step", "mfu", "device_kind", "batch", "hw",
+            "precision",
+        )
+        out = {k: entry[k] for k in keep if k in entry}
+        out["measured_utc"] = report.get("started_utc")
+        return out
+    except Exception:
+        return None
+
+
 def main():
     import argparse
 
@@ -491,17 +516,22 @@ def main():
     args = parser.parse_args()
 
     def _fail(error: str):
-        print(
-            json.dumps(
-                {
-                    "metric": "uieb_train_images_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "images/sec/chip",
-                    "vs_baseline": 0.0,
-                    "error": error,
-                }
-            )
-        )
+        line = {
+            "metric": "uieb_train_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "error": error,
+        }
+        # The measurement FAILED NOW (value stays 0.0) — but if a previous
+        # session measured this metric on real hardware, attach that result
+        # so a dead tunnel doesn't erase on-hardware evidence. Clearly
+        # labeled with its capture timestamp; docs/TPU_RESULTS.md has the
+        # full session.
+        prior = _last_measured_headline()
+        if prior is not None:
+            line["last_measured_on_hardware"] = prior
+        print(json.dumps(line))
         raise SystemExit(1)
 
     if os.environ.get("WATERNET_BENCH_CHILD") != "1":
