@@ -1,0 +1,66 @@
+"""Unit tests for tools/relay_watch.py's passive TCP-state logic."""
+
+import importlib
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+relay_watch = importlib.import_module("relay_watch")
+
+
+def _states_text(rows):
+    """Build /proc/net/tcp content from (local_port, remote_port, state)."""
+    header = "  sl  local_address rem_address   st ..."
+    lines = [header]
+    for i, (lp, rp, st) in enumerate(rows):
+        lines.append(f"   {i}: 0100007F:{lp:04X} 0100007F:{rp:04X} {st} ...")
+    return "\n".join(lines)
+
+
+def test_parse_tcp_extracts_ports_and_state():
+    text = _states_text([(8082, 0, "0A"), (51234, 8113, "01")])
+    assert relay_watch._parse_tcp(text) == [
+        (8082, 0, "0A"),
+        (51234, 8113, "01"),
+    ]
+
+
+def test_relay_listening_requires_listen_state_on_primary_port():
+    listen = [(relay_watch.RELAY_PORT, 0, "0A")]
+    est_only = [(relay_watch.RELAY_PORT, 51234, "01")]
+    assert relay_watch.relay_listening(listen)
+    assert not relay_watch.relay_listening(est_only)
+    assert not relay_watch.relay_listening([(9999, 0, "0A")])
+
+
+def test_relay_busy_covers_the_whole_stack_not_just_primary():
+    base = relay_watch.RELAY_PORT
+    # Relay stack listening on the grid; client mid-compile on base+21
+    # (the 8103-style compile service) with NO connection to the primary.
+    states = [
+        (base, 0, "0A"),
+        (base + 21, 0, "0A"),
+        (51234, base + 21, "01"),
+    ]
+    assert relay_watch.relay_busy(states)
+
+
+def test_relay_busy_ignores_unrelated_services():
+    base = relay_watch.RELAY_PORT
+    # A service outside the stack window with an established client, plus
+    # an established connection to a port nobody in the window listens on.
+    states = [
+        (base, 0, "0A"),
+        (base + 2000, 0, "0A"),
+        (51234, base + 2000, "01"),
+        (51235, 65000, "01"),
+    ]
+    assert not relay_watch.relay_busy(states)
+
+
+def test_relay_busy_idle_stack_is_not_busy():
+    base = relay_watch.RELAY_PORT
+    states = [(base, 0, "0A"), (base + 31, 0, "0A")]
+    assert not relay_watch.relay_busy(states)

@@ -28,32 +28,38 @@ REPO = Path(__file__).resolve().parent.parent
 RELAY_PORT = int(os.environ.get("WATERNET_RELAY_PORT", "8082"))
 
 
-def _tcp_states():
-    """[(local_port, remote_port, state_hex)] from /proc/net/tcp{,6}."""
+def _parse_tcp(text: str):
+    """/proc/net/tcp{,6} content -> [(local_port, remote_port, state_hex)]."""
     out = []
-    for f in ("/proc/net/tcp", "/proc/net/tcp6"):
-        try:
-            lines = Path(f).read_text().splitlines()[1:]
-        except OSError:
-            continue
-        for ln in lines:
-            p = ln.split()
-            if len(p) > 3:
-                out.append(
-                    (
-                        int(p[1].split(":")[1], 16),
-                        int(p[2].split(":")[1], 16),
-                        p[3],
-                    )
+    for ln in text.splitlines()[1:]:
+        p = ln.split()
+        if len(p) > 3:
+            out.append(
+                (
+                    int(p[1].split(":")[1], 16),
+                    int(p[2].split(":")[1], 16),
+                    p[3],
                 )
+            )
     return out
 
 
-def relay_listening() -> bool:
-    return any(lp == RELAY_PORT and st == "0A" for lp, _, st in _tcp_states())
+def _tcp_states():
+    out = []
+    for f in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            out.extend(_parse_tcp(Path(f).read_text()))
+        except OSError:
+            continue
+    return out
 
 
-def relay_busy() -> bool:
+def relay_listening(states=None) -> bool:
+    states = _tcp_states() if states is None else states
+    return any(lp == RELAY_PORT and st == "0A" for lp, _, st in states)
+
+
+def relay_busy(states=None) -> bool:
     """True if a client holds a connection into the relay STACK — not just
     the primary port. The tunnel spans a grid of services (observed LISTEN
     set: 8082/83/87, 8092/93/97, ... 8112/13/117; the recorded session
@@ -62,7 +68,7 @@ def relay_busy() -> bool:
     Busy = any ESTABLISHED connection whose endpoint is a port the relay
     stack currently LISTENs on (ports near RELAY_PORT), which excludes
     unrelated services outside that window."""
-    states = _tcp_states()
+    states = _tcp_states() if states is None else states
     stack_ports = {
         lp
         for lp, _, st in states
@@ -80,6 +86,15 @@ def main():
     p.add_argument("--stable", type=float, default=30.0)
     p.add_argument("--max-hours", type=float, default=10.0)
     p.add_argument(
+        "--max-launches",
+        type=int,
+        default=1,
+        help="re-arm after a session exits (a NEW tunnel death mid-run "
+        "loses nothing: --resume skips completed stages). Each launch "
+        "still waits for a stable, idle relay; >1 only makes sense with "
+        "the session's incremental-save design.",
+    )
+    p.add_argument(
         "--session-args",
         default="--resume --skip-video "
         "--ab-variants all-except:clahe_interp_gather",
@@ -89,7 +104,9 @@ def main():
     deadline = time.time() + args.max_hours * 3600
     log = lambda m: print(f"[relay_watch] {m}", file=sys.stderr, flush=True)
     log(f"watching for relay LISTEN on :{RELAY_PORT} (passive)")
-    while time.time() < deadline:
+    launches = 0
+    rc = 1
+    while time.time() < deadline and launches < args.max_launches:
         if relay_listening():
             log(f"relay up; stabilizing {args.stable:.0f}s")
             time.sleep(args.stable)
@@ -102,13 +119,19 @@ def main():
                 continue
             cmd = [sys.executable, str(REPO / "tools" / "tpu_session.py")]
             cmd += args.session_args.split()
-            log(f"launching: {' '.join(cmd)}")
+            launches += 1
+            log(f"launch {launches}/{args.max_launches}: {' '.join(cmd)}")
             rc = subprocess.call(cmd, cwd=str(REPO))
-            log(f"tpu_session exited rc={rc}; watcher done")
-            return rc
+            log(f"tpu_session exited rc={rc}")
+            if rc == 0:
+                log("session completed; watcher done")
+                return 0
         time.sleep(args.poll)
-    log("deadline reached without a live relay; giving up")
-    return 1
+    if launches == 0:
+        log("deadline reached without a live relay; giving up")
+    else:
+        log("launch budget exhausted; watcher done")
+    return rc
 
 
 if __name__ == "__main__":
