@@ -129,8 +129,10 @@ def main():
         time.sleep(args.poll)
     if launches == 0:
         log("deadline reached without a live relay; giving up")
-    else:
+    elif launches >= args.max_launches:
         log("launch budget exhausted; watcher done")
+    else:
+        log(f"deadline reached after {launches} launch(es); watcher done")
     return rc
 
 

@@ -50,6 +50,7 @@ def parse_args(argv=None):
     p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual loss")
     p.add_argument("--no-perceptual", action="store_true", help="Disable the VGG perceptual term")
     p.add_argument("--host-preprocess", action="store_true", help="cv2/NumPy WB+GC+CLAHE on host (bit-exact, slow)")
+    p.add_argument("--device-cache", action="store_true", help="Pin the whole uint8 dataset in device memory (UIEB@112x112 ~60 MB) and gather batches on device: zero per-step host feed, bit-identical epochs (same Philox shuffle + augment streams)")
     p.add_argument("--no-shuffle", action="store_true", help="Reference bug-compat: no train shuffling")
     p.add_argument("--no-augment", action="store_true", help="Disable flips/rot90 augmentation")
     p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from, or 'auto' to pick up the latest run's state")
@@ -167,25 +168,38 @@ def main(argv=None):
         # Process 0 only: N identical event files would jitter the curves.)
         tb_writer = tf.summary.create_file_writer(str(savedir / "tb"))
 
+    if args.device_cache:
+        if args.host_preprocess:
+            raise SystemExit("--device-cache requires device preprocessing")
+        engine.cache_dataset(dataset, train_idx)
+
     profile_epoch = min(1, args.epochs - 1)  # first post-compilation epoch
     for epoch in range(args.epochs):
         if args.profile_dir and epoch == profile_epoch:
             jax.profiler.start_trace(args.profile_dir)
         t0 = time.perf_counter()
-        train_metrics = engine.train_epoch(
-            dataset.batches(
-                train_idx,
-                config.batch_size,
-                shuffle=config.shuffle,
-                seed=config.seed,
+        if args.device_cache:
+            train_metrics = engine.train_epoch_cached(epoch=epoch)
+        else:
+            train_metrics = engine.train_epoch(
+                dataset.batches(
+                    train_idx,
+                    config.batch_size,
+                    shuffle=config.shuffle,
+                    seed=config.seed,
+                    epoch=epoch,
+                ),
                 epoch=epoch,
-            ),
-            epoch=epoch,
-        )
+            )
         train_dt = time.perf_counter() - t0
-        val_metrics = engine.eval_epoch(
-            dataset.batches(val_idx, config.batch_size, shuffle=False)
-        )
+        if args.device_cache:
+            val_metrics = engine.eval_epoch_cached(
+                dataset=dataset, indices=val_idx
+            )
+        else:
+            val_metrics = engine.eval_epoch(
+                dataset.batches(val_idx, config.batch_size, shuffle=False)
+            )
         dt = time.perf_counter() - t0
         if args.profile_dir and epoch == profile_epoch:
             jax.profiler.stop_trace()

@@ -7,6 +7,18 @@ from typing import Callable, Iterator, Tuple
 import numpy as np
 
 
+def epoch_permutation(indices, seed: int, epoch: int) -> np.ndarray:
+    """Deterministic Philox shuffle of ``indices`` for (seed, epoch).
+
+    The single source of the epoch-shuffle stream: the host-fed iterator and
+    the device-cache path (trainer._cached_index_batches) both use it, which
+    is what makes --device-cache epochs bit-identical to host-fed ones.
+    """
+    order = np.array(indices, copy=True)
+    np.random.Generator(np.random.Philox(key=seed + 7919 * epoch)).shuffle(order)
+    return order
+
+
 def iter_batches(
     load_pair: Callable[[int], Tuple[np.ndarray, np.ndarray]],
     indices,
@@ -21,9 +33,10 @@ def iter_batches(
     Shuffle order is a deterministic function of (seed, epoch) via Philox, so
     epochs are reproducible and resume replays the same order.
     """
-    order = np.array(indices, copy=True)
     if shuffle:
-        np.random.Generator(np.random.Philox(key=seed + 7919 * epoch)).shuffle(order)
+        order = epoch_permutation(indices, seed, epoch)
+    else:
+        order = np.array(indices, copy=True)
     n = len(order)
     stop = n - n % batch_size if drop_remainder else n
     for start in range(0, stop, batch_size):

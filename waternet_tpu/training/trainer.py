@@ -261,6 +261,32 @@ class TrainingEngine:
             )
             return self._metrics(out, refn, aux, mask)
 
+        def _gather_cached(cache_raw, cache_ref, idx):
+            """Batch gather from the HBM-resident dataset, inside the step.
+
+            The cache is replicated (UIEB uint8 at training sizes is tens of
+            MB — trivial HBM), so each device slices its own batch shard
+            locally; the constraint tells the partitioner the gathered batch
+            is sharded exactly like a host-fed one (data axis, and the H
+            axis when spatial sharding is on).
+            """
+            raw = jnp.take(cache_raw, idx, axis=0)
+            ref = jnp.take(cache_ref, idx, axis=0)
+            return (
+                jax.lax.with_sharding_constraint(raw, bsh),
+                jax.lax.with_sharding_constraint(ref, bsh),
+            )
+
+        def train_step_cached(
+            state: TrainStateT, cache_raw, cache_ref, idx, rng, n_real
+        ):
+            raw_u8, ref_u8 = _gather_cached(cache_raw, cache_ref, idx)
+            return train_step(state, raw_u8, ref_u8, rng, n_real)
+
+        def eval_step_cached(state: TrainStateT, cache_raw, cache_ref, idx, n_real):
+            raw_u8, ref_u8 = _gather_cached(cache_raw, cache_ref, idx)
+            return eval_step(state, raw_u8, ref_u8, n_real)
+
         self.train_step = jax.jit(
             train_step,
             in_shardings=(rep, bsh, bsh, rep, rep),
@@ -279,6 +305,17 @@ class TrainingEngine:
         )
         self.eval_step_pre = jax.jit(
             eval_step_pre, in_shardings=(rep,) + pre_b + (rep,), out_shardings=rep
+        )
+        self.train_step_cached = jax.jit(
+            train_step_cached,
+            in_shardings=(rep, rep, rep, rep, rep, rep),
+            out_shardings=(rep, rep),
+            donate_argnums=(0,),
+        )
+        self.eval_step_cached = jax.jit(
+            eval_step_cached,
+            in_shardings=(rep, rep, rep, rep, rep),
+            out_shardings=rep,
         )
 
     def _to_global(self, arr):
@@ -341,6 +378,137 @@ class TrainingEngine:
             np.stack(list(arrs)).astype(np.float32) / 255.0
         )
         return as_f(raw), as_f(wbs), as_f(hes), as_f(gcs), as_f(ref)
+
+    # ------------------------------------------------------------------
+    # Device-resident dataset cache
+    # ------------------------------------------------------------------
+
+    def _replicate_global(self, arr):
+        """Host array -> globally-replicated device array (multi-host safe:
+        device_put cannot target non-addressable devices, so multi-process
+        meshes go through make_array_from_callback with every host holding
+        the identical full array — same contract as _to_global)."""
+        rep = replicated(self.mesh)
+        if jax.process_count() == 1:
+            return jax.device_put(jnp.asarray(arr), rep)
+        import numpy as np
+
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(
+            arr.shape, rep, lambda idx: arr[idx]
+        )
+
+    def _build_cache(self, dataset, indices):
+        import numpy as np
+
+        pairs = [dataset.load_pair(int(i)) for i in indices]
+        return (
+            self._replicate_global(np.stack([p[0] for p in pairs])),
+            self._replicate_global(np.stack([p[1] for p in pairs])),
+        )
+
+    def cache_dataset(self, dataset, indices) -> None:
+        """Pin uint8 (raw, ref) pairs for ``indices`` in device memory.
+
+        The reference re-decodes every PNG every epoch on the host
+        (`/root/reference/waternet/training_utils.py:91-107`); our host RAM
+        cache already fixes the decode, and this removes the per-step
+        host->device feed entirely: the full dataset lives in HBM (UIEB-800
+        uint8 at 112x112 is ~60 MB, at 256x256 ~315 MB) and every step
+        gathers its batch on device from int32 indices (a few hundred bytes
+        of host traffic per step). Semantics are identical to the host-fed
+        path — augmentation + WB/GC/CLAHE still run per step inside the
+        jitted program, after the gather.
+        """
+        self._cache_raw, self._cache_ref = self._build_cache(dataset, indices)
+
+    def _cached_index_batches(self, n: int, epoch: int, shuffle: bool):
+        """Yield (idx_int32, n_real) covering all n items; the tail batch
+        repeats the last index and is masked via n_real (as _pad_batch)."""
+        import numpy as np
+
+        from waternet_tpu.data.batching import epoch_permutation
+
+        b = self.config.batch_size
+        n_data = self.mesh.shape[DATA_AXIS]
+        if shuffle:
+            # Same Philox stream as the host-fed iterator: shuffling cache
+            # *positions* with the same key yields exactly the batch
+            # composition iter_batches would load, so --device-cache
+            # replays host-path epochs bit-for-bit.
+            order = epoch_permutation(
+                np.arange(n), self.config.seed, epoch
+            )
+        else:
+            order = np.arange(n)
+        for start in range(0, n, b):
+            idx = order[start : start + b]
+            n_real = len(idx)
+            pad_to = -(-n_real // n_data) * n_data  # data-axis multiple
+            if n_real < pad_to:
+                idx = np.concatenate([idx, np.repeat(idx[-1], pad_to - n_real)])
+            yield idx.astype(np.int32), n_real
+
+    def train_epoch_cached(self, epoch: int) -> dict:
+        """One epoch over the cached dataset; same metric contract as
+        :meth:`train_epoch`. Requires :meth:`cache_dataset` first."""
+        if getattr(self, "_cache_raw", None) is None:
+            raise RuntimeError("call cache_dataset() before train_epoch_cached()")
+        if self.config.host_preprocess:
+            raise RuntimeError(
+                "device cache requires device preprocessing "
+                "(host_preprocess=False)"
+            )
+        sums = {k: 0.0 for k in TRAIN_METRICS_NAMES}
+        count = 0
+        base_rng = jax.random.PRNGKey(self.config.seed + 1)
+        pending = []
+        n = self._cache_raw.shape[0]
+        for idx, n_real in self._cached_index_batches(
+            n, epoch, self.config.shuffle
+        ):
+            rng = jax.random.fold_in(jax.random.fold_in(base_rng, epoch), count)
+            self.state, metrics = self.train_step_cached(
+                self.state, self._cache_raw, self._cache_ref,
+                self._replicate_global(idx), rng, n_real,
+            )
+            pending.append(metrics)
+            count += 1
+        for metrics in pending:
+            for k in sums:
+                sums[k] += float(metrics[k])
+        return {k: v / max(count, 1) for k, v in sums.items()}
+
+    def eval_epoch_cached(self, dataset=None, indices=None) -> dict:
+        """Eval over a device-resident cache. With dataset/indices given,
+        builds (and memoizes) a val cache keyed on exactly those indices —
+        a different dataset or index set rebuilds it."""
+        if dataset is not None:
+            key = (id(dataset), tuple(int(i) for i in indices))
+            if getattr(self, "_val_cache_key", None) != key:
+                self._val_cache = self._build_cache(dataset, indices)
+                self._val_cache_key = key
+            cache_raw, cache_ref = self._val_cache
+        else:
+            if getattr(self, "_cache_raw", None) is None:
+                raise RuntimeError("no cached dataset for eval_epoch_cached()")
+            cache_raw, cache_ref = self._cache_raw, self._cache_ref
+        sums = {k: 0.0 for k in VAL_METRICS_NAMES}
+        count = 0
+        pending = []
+        n = cache_raw.shape[0]
+        for idx, n_real in self._cached_index_batches(n, epoch=0, shuffle=False):
+            pending.append(
+                self.eval_step_cached(
+                    self.state, cache_raw, cache_ref,
+                    self._replicate_global(idx), n_real,
+                )
+            )
+            count += 1
+        for metrics in pending:
+            for k in sums:
+                sums[k] += float(metrics[k])
+        return {k: v / max(count, 1) for k, v in sums.items()}
 
     # ------------------------------------------------------------------
     # Epoch drivers
