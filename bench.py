@@ -397,6 +397,63 @@ def _relay_listening(port: int | None = None) -> bool | None:
     return False if saw_table else None
 
 
+def _relay_busy(port: int | None = None) -> bool:
+    """Does another client hold a connection into the relay STACK? Parsed
+    passively from /proc/net/tcp (same discipline as _relay_listening). The
+    stack spans a port grid near the primary (compile service :8103, device
+    connections :8113, ... when the relay is at :8082); any ESTABLISHED
+    connection to a port the stack currently LISTENs on means a measurement
+    session is mid-flight — a second client connecting then can wedge the
+    single-client tunnel for both."""
+    port = port or _env_int("WATERNET_RELAY_PORT", 8082)
+    states = []
+    for table in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            with open(table) as f:
+                lines = f.readlines()[1:]
+        except OSError:
+            continue
+        for line in lines:
+            cols = line.split()
+            if len(cols) > 3:
+                states.append(
+                    (
+                        int(cols[1].split(":")[1], 16),
+                        int(cols[2].split(":")[1], 16),
+                        cols[3],
+                    )
+                )
+    stack = {
+        lp for lp, _, st in states if st == "0A" and port - 2 <= lp < port + 38
+    }
+    return any(
+        st == "01" and (lp in stack or rp in stack) for lp, rp, st in states
+    )
+
+
+def _wait_if_relay_busy(budget_s: int) -> bool:
+    """Poll passively until no other client holds the relay (True), or the
+    budget expires (False). Keeps the driver's end-of-round bench from
+    racing a watcher-launched measurement session into the two-client
+    wedge."""
+    import sys
+
+    t0 = time.perf_counter()
+    warned = False
+    while _relay_busy():
+        if time.perf_counter() - t0 > budget_s:
+            return False
+        if not warned:
+            print(
+                "bench: another client holds the accelerator relay; "
+                f"waiting up to {budget_s}s for it to finish",
+                file=sys.stderr,
+            )
+            warned = True
+        time.sleep(15)
+    return True
+
+
 def _env_int(name: str, default: int) -> int:
     """int(os.environ[name]) with a loud fallback instead of a traceback —
     every failure path must still emit the one-line JSON contract."""
@@ -541,6 +598,14 @@ def main():
         # 1080p compiles), hence the larger default budget.
         if _relay_listening() is False:
             _fail("accelerator tunnel relay is not listening (chip unreachable)")
+        if _relay_listening() and not _wait_if_relay_busy(
+            _env_int("WATERNET_BENCH_BUSY_WAIT", 1200)
+        ):
+            _fail(
+                "another client held the accelerator relay for the whole "
+                "busy-wait budget; refusing to race it into a two-client "
+                "tunnel wedge"
+            )
         train_t = _env_int("WATERNET_BENCH_TIMEOUT", 600)
         if args.config == "video":
             # Video compiles run long; its budget has its own knob so tuning

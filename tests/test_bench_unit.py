@@ -191,3 +191,42 @@ def test_failed_bench_line_carries_last_measured(monkeypatch):
     prior = line["last_measured_on_hardware"]
     assert prior["value"] == pytest.approx(334.55)
     assert prior["measured_utc"].startswith("2026-")
+
+
+def test_relay_busy_parses_stack_connections(bench, monkeypatch, tmp_path):
+    tcp = tmp_path / "tcp"
+    import builtins
+
+    real_open = builtins.open
+
+    def fake_open(path, *a, **k):
+        if path == "/proc/net/tcp":
+            return real_open(tcp)
+        if path == "/proc/net/tcp6":
+            raise OSError
+        return real_open(path, *a, **k)
+
+    monkeypatch.setattr(builtins, "open", fake_open)
+    header = "  sl  local_address rem_address   st ...\n"
+    # Stack listening at 8082 + a client established on the compile port.
+    tcp.write_text(
+        header
+        + "   0: 0100007F:1F92 00000000:0000 0A ...\n"  # 8082 LISTEN
+        + "   1: 0100007F:1FA7 00000000:0000 0A ...\n"  # 8103 LISTEN
+        + "   2: 0100007F:C8FE 0100007F:1FA7 01 ...\n"  # client -> 8103
+    )
+    assert bench._relay_busy(8082) is True
+    # Same stack, no established connections -> idle.
+    tcp.write_text(
+        header
+        + "   0: 0100007F:1F92 00000000:0000 0A ...\n"
+        + "   1: 0100007F:1FA7 00000000:0000 0A ...\n"
+    )
+    assert bench._relay_busy(8082) is False
+    # Established connection outside the stack window -> not busy.
+    tcp.write_text(
+        header
+        + "   0: 0100007F:1F92 00000000:0000 0A ...\n"
+        + "   1: 0100007F:C8FE 0100007F:1F40 01 ...\n"  # client -> 8000
+    )
+    assert bench._relay_busy(8082) is False
