@@ -47,6 +47,28 @@ def _fake_report():
             },
             "profile": {"ok": False, "error": "RuntimeError: unsupported",
                         "wall_sec": 2.0},
+            "link_bandwidth": {
+                "ok": True, "payload_mb": 32, "h2d_MB_per_s": 5.1,
+                "d2h_MB_per_s": 6.2, "wall_sec": 13.0,
+            },
+            "preprocess_breakdown": {
+                "ok": True, "batch": 16, "hw": 112, "wb_ms": 4.0,
+                "gamma_ms": 0.4, "histeq_ms": 12.0, "transform_all_ms": 17.0,
+                "wall_sec": 30.0,
+            },
+            "video_1080p_device_resident": {
+                "ok": True,
+                "metric": "video_1080p_device_resident_frames_per_sec_per_chip",
+                "value": 9.0, "batch": 4, "frame_ms": 111.0, "wall_sec": 40.0,
+            },
+            "train_bf16_batch64": {
+                "ok": True, "value": 700.0, "step_ms": 91.0, "mfu": 0.3,
+                "wall_sec": 200.0,
+            },
+            "train_bf16_256x256_batch8": {
+                "ok": True, "value": 120.0, "step_ms": 66.0, "mfu": 0.28,
+                "wall_sec": 200.0,
+            },
         },
     }
 
@@ -60,6 +82,12 @@ def test_render_markdown_covers_all_sections():
     assert "112x112, batch 16, perceptual ON" in md
     assert "`profile`: RuntimeError: unsupported" in md
     assert "(in progress / interrupted)" in md    # no finished_utc
+    # Micro-measurement sections
+    assert "5.1 MB/s up" in md
+    assert "CLAHE histeq 12.0 ms" in md
+    assert "device_resident_frames_per_sec_per_chip | 4 | 9.0" in md
+    assert "Throughput-optimal batch 64: **700.0 images/sec/chip**" in md
+    assert "256x256, batch 8)" in md and "120.0 images/sec/chip" in md
 
 
 def test_render_markdown_minimal_report():
