@@ -517,7 +517,9 @@ def main():
         s.run_stage(
             "profile",
             lambda: stage_profile(
-                REPO / "docs" / "profile_trace", hw=args.hw, batch=args.batch
+                Path(args.out).parent / "profile_trace",
+                hw=args.hw,
+                batch=args.batch,
             ),
         )
 
@@ -526,7 +528,7 @@ def main():
             "convergence",
             lambda: stage_convergence(
                 args.convergence_epochs,
-                REPO / "docs" / "convergence_tpu.csv",
+                Path(args.out).parent / "convergence_tpu.csv",
                 hw=args.hw,
                 batch=args.batch,
             ),
