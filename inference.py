@@ -164,6 +164,47 @@ def make_split(bgr_before, bgr_after):
     return composite
 
 
+def run_images_batched(
+    engine, paths, savedir: Path, show_split: bool, batch_size: int
+):
+    """Enhance a stream of image files with shape-aware batching.
+
+    Consecutive same-shaped images are stacked into device batches of up to
+    ``batch_size`` (the common case for datasets like UIEB, where one
+    compiled executable then serves every batch); a shape change flushes the
+    pending batch, so mixed-resolution directories degrade to the
+    reference's one-image-at-a-time behavior (`/root/reference/
+    inference.py:167-233`) rather than recompiling per permutation.
+    """
+    import cv2
+
+    pending = []  # [(path, bgr, rgb)] — all same shape
+
+    def flush():
+        if not pending:
+            return
+        batch = np.stack([rgb for _, _, rgb in pending])
+        outs = engine.enhance(batch)
+        savedir.mkdir(parents=True, exist_ok=True)
+        for (path, bgr, _), out_rgb in zip(pending, outs):
+            out_bgr = cv2.cvtColor(out_rgb, cv2.COLOR_RGB2BGR)
+            out = make_split(bgr, out_bgr) if show_split else out_bgr
+            cv2.imwrite(str(savedir / path.name), out)
+        pending.clear()
+
+    for path in paths:
+        bgr = cv2.imread(str(path))
+        if bgr is None:
+            print(f"Skipping unreadable image: {path}", file=sys.stderr)
+            continue
+        if pending and bgr.shape != pending[0][1].shape:
+            flush()
+        pending.append((path, bgr, cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)))
+        if len(pending) >= batch_size:
+            flush()
+    flush()
+
+
 def run_image(engine, path: Path, savedir: Path, show_split: bool):
     import cv2
 
