@@ -17,9 +17,12 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 
 import numpy as np
+
+from waternet_tpu.utils.platform import relay_stack_busy
 
 BASELINE_IMG_PER_SEC = 12.0
 # Env overrides let CI smoke-run the benchmark at reduced size on CPU.
@@ -345,10 +348,12 @@ def measure_train(
     }
     # Which classical-op strategies this number was measured with.
     from waternet_tpu.ops.clahe import TILE_GRID, _hist_mode, _interp_mode
+    from waternet_tpu.ops.color import _srgb_transfer_mode
 
     ty, tx = TILE_GRID
     line["clahe_hist"] = _hist_mode(None)
     line["clahe_interp"] = _interp_mode(hw // ty, hw // tx)
+    line["srgb_transfer"] = _srgb_transfer_mode()
     return line
 
 
@@ -423,12 +428,7 @@ def _relay_busy(port: int | None = None) -> bool:
                         cols[3],
                     )
                 )
-    stack = {
-        lp for lp, _, st in states if st == "0A" and port - 2 <= lp < port + 38
-    }
-    return any(
-        st == "01" and (lp in stack or rp in stack) for lp, rp, st in states
-    )
+    return relay_stack_busy(states, port)
 
 
 def _wait_if_relay_busy(budget_s: int) -> bool:
@@ -532,27 +532,57 @@ def _run_benchmark_child(timeout_s: int):
     return None
 
 
+_HEADLINE_STAGE_RE = re.compile(r"^train_bf16(?:_r(\d+))?$")
+
+
+def headline_stage_candidates(stages):
+    """ok ``train_bf16`` / ``train_bf16_rN`` session stages as
+    ``[(name, entry), ...]``, newest round first (the bare round-2 name
+    sorts oldest). Session stage names carry a round tag because resume
+    skips ok stages — each round's optimized code is re-measured under a
+    fresh name — and this helper is the ONE place that decodes that
+    convention (tools/tpu_session.py's renderer uses it too, so future
+    rounds only add a stage, not edit two files)."""
+    found = []
+    for name, entry in stages.items():
+        m = _HEADLINE_STAGE_RE.match(name)
+        if m and entry.get("ok"):
+            found.append((int(m.group(1) or 0), name, entry))
+    return [(name, entry) for _, name, entry in sorted(found, key=lambda t: -t[0])]
+
+
 def _last_measured_headline():
-    """The train_bf16 result from the most recent tools/tpu_session.py run
-    on a real TPU (docs/tpu_session.json), or None. Used to annotate a
+    """The newest headline train result from a tools/tpu_session.py run on
+    a real TPU (docs/tpu_session.json), or None. Used to annotate a
     failed bench line — measured evidence shouldn't vanish because the
-    fragile tunnel is down at harvest time."""
+    fragile tunnel is down at harvest time. Non-TPU entries (CPU
+    rehearsals) are skipped per-candidate: an ok CPU r3 stage must not
+    shadow real round-2 TPU evidence."""
     try:
         with open(
             os.path.join(os.path.dirname(__file__), "docs", "tpu_session.json")
         ) as f:
             report = json.load(f)
-        entry = report["stages"]["train_bf16"]
-        if not entry.get("ok") or "tpu" not in entry.get("device_kind", "").lower():
-            return None
-        keep = (
-            "value", "unit", "vs_baseline", "step_ms", "preprocess_ms",
-            "model_tflop_per_step", "mfu", "device_kind", "batch", "hw",
-            "precision",
-        )
-        out = {k: entry[k] for k in keep if k in entry}
-        out["measured_utc"] = report.get("started_utc")
-        return out
+        for _, entry in headline_stage_candidates(report["stages"]):
+            if "tpu" not in entry.get("device_kind", "").lower():
+                continue
+            keep = (
+                "value", "unit", "vs_baseline", "step_ms", "preprocess_ms",
+                "model_tflop_per_step", "mfu", "device_kind", "batch", "hw",
+                "precision", "srgb_transfer",
+            )
+            out = {k: entry[k] for k in keep if k in entry}
+            # Prefer the stage's own timestamp (run_stage stamps one); a
+            # legacy entry carried across a resume predates the current
+            # session, so fall back to the session it was resumed FROM
+            # before the current started_utc.
+            out["measured_utc"] = (
+                entry.get("measured_utc")
+                or report.get("resumed_from_utc")
+                or report.get("started_utc")
+            )
+            return out
+        return None
     except Exception:
         return None
 

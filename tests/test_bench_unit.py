@@ -167,6 +167,35 @@ def test_last_measured_headline_rejects_cpu_or_missing(bench, monkeypatch, tmp_p
     assert bench._last_measured_headline() is None
 
 
+def test_headline_candidates_order_and_tpu_fallback(bench, monkeypatch, tmp_path):
+    """Newest round first; an ok-but-non-TPU r3 rehearsal entry must not
+    shadow real round-2 TPU evidence (the device check is per-candidate)."""
+    stages = {
+        "train_bf16": {"ok": True, "value": 334.0, "device_kind": "TPU v5 lite"},
+        "train_bf16_r3": {"ok": True, "value": 5.0, "device_kind": "cpu"},
+        "train_bf16_batch64": {"ok": True, "value": 700.0},  # not a headline
+        "ab_fp32": {"ok": True, "value": 200.0},
+    }
+    names = [n for n, _ in bench.headline_stage_candidates(stages)]
+    assert names == ["train_bf16_r3", "train_bf16"]
+
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "tpu_session.json").write_text(
+        json.dumps({"started_utc": "2026-07-29T13:49:46Z", "stages": stages})
+    )
+    got = bench._last_measured_headline()
+    assert got is not None and got["value"] == 334.0
+
+    # With a TPU-measured r3 entry, the newest round wins.
+    stages["train_bf16_r3"]["device_kind"] = "TPU v5 lite"
+    (docs / "tpu_session.json").write_text(
+        json.dumps({"started_utc": "2026-07-29T13:49:46Z", "stages": stages})
+    )
+    assert bench._last_measured_headline()["value"] == 5.0
+
+
 def test_failed_bench_line_carries_last_measured(monkeypatch):
     # Parent role with the relay forced "down": the emitted line must keep
     # value 0.0 AND attach the session's measured headline.
@@ -188,9 +217,13 @@ def test_failed_bench_line_carries_last_measured(monkeypatch):
     line = json.loads(proc.stdout.strip().splitlines()[-1])
     assert line["value"] == 0.0
     assert "error" in line
+    # Structural assertions only: the armed relay watcher re-captures
+    # docs/tpu_session.json whenever the chip answers, so the exact
+    # throughput number is expected to change between captures.
     prior = line["last_measured_on_hardware"]
-    assert prior["value"] == pytest.approx(334.55)
-    assert prior["measured_utc"].startswith("2026-")
+    assert prior["value"] > 0
+    assert "tpu" in prior["device_kind"].lower()
+    assert prior["measured_utc"]
 
 
 def test_relay_busy_parses_stack_connections(bench, monkeypatch, tmp_path):
@@ -228,5 +261,14 @@ def test_relay_busy_parses_stack_connections(bench, monkeypatch, tmp_path):
         header
         + "   0: 0100007F:1F92 00000000:0000 0A ...\n"
         + "   1: 0100007F:C8FE 0100007F:1F40 01 ...\n"  # client -> 8000
+    )
+    assert bench._relay_busy(8082) is False
+    # A dev server on 8080 (port-2) with a live client must not read as
+    # relay-busy: the stack window starts AT the relay port.
+    tcp.write_text(
+        header
+        + "   0: 0100007F:1F92 00000000:0000 0A ...\n"  # 8082 LISTEN
+        + "   1: 0100007F:1F90 00000000:0000 0A ...\n"  # 8080 LISTEN
+        + "   2: 0100007F:C8FE 0100007F:1F90 01 ...\n"  # client -> 8080
     )
     assert bench._relay_busy(8082) is False

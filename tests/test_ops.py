@@ -346,6 +346,63 @@ def test_histeq_device_close_to_host(sample_rgb):
     assert (diff > 0).mean() < 0.10
 
 
+def test_srgb_poly_transfer_matches_float_formula(monkeypatch):
+    """The default poly linear->sRGB transfer tracks the literal
+    ``1.055*x**(1/2.4)-0.055`` formula to <1e-3 of one 8-bit level on
+    [cut, 1], is exact on the linear branch, and agrees at/above 1 after
+    the 255 clip. Exhaustive LAB-cube characterization (2026-07-29): the
+    rounded outputs are bit-identical except ±1 level on 4.5e-6 of the
+    cube; parity vs cv2 is identical for both transfers (max 3 levels,
+    >1 level on 1.06e-5)."""
+    from waternet_tpu.ops import color
+
+    x = np.concatenate(
+        [
+            np.linspace(-0.5, color._SRGB_CUT, 1001, dtype=np.float32),
+            np.linspace(color._SRGB_CUT, 1.0, 200_001, dtype=np.float32),
+            np.linspace(1.0, 4.0, 101, dtype=np.float32),
+        ]
+    )
+    monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "poly")
+    poly = np.asarray(color._linear_to_srgb(x))
+    monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "float")
+    flt = np.asarray(color._linear_to_srgb(x))
+    in_gamut = (x >= color._SRGB_CUT) & (x <= 1.0)
+    assert np.abs(255.0 * (poly[in_gamut] - flt[in_gamut])).max() < 1e-3
+    linear_branch = x <= color._SRGB_CUT
+    np.testing.assert_array_equal(poly[linear_branch], flt[linear_branch])
+    over = x > 1.0
+    np.testing.assert_array_equal(
+        np.clip(np.round(255.0 * poly[over]), 0, 255),
+        np.clip(np.round(255.0 * flt[over]), 0, 255),
+    )
+
+
+def test_srgb_transfer_mode_rejects_unknown(monkeypatch):
+    """A typo in WATERNET_SRGB_TRANSFER must fail, not silently change
+    the measured path (same contract as the CLAHE mode flags)."""
+    from waternet_tpu.ops import color
+
+    monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "lut")
+    with pytest.raises(ValueError, match="WATERNET_SRGB_TRANSFER"):
+        color._srgb_transfer_mode()
+
+
+def test_lab_inverse_poly_vs_float_levels(rng, monkeypatch):
+    """Rounded-u8 outputs of the two transfer modes agree except for the
+    rare ±1-level boundary flips (exhaustive bound: 4.5e-6 of the cube)."""
+    from waternet_tpu.ops.color import lab_u8_to_rgb
+
+    lab = rng.integers(0, 256, (128, 128, 3)).astype(np.float32)
+    monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "poly")
+    poly = np.asarray(lab_u8_to_rgb(lab))
+    monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "float")
+    flt = np.asarray(lab_u8_to_rgb(lab))
+    diff = np.abs(poly - flt)
+    assert diff.max() <= 1.0, diff.max()
+    assert (diff > 0).mean() < 1e-4
+
+
 # ---------------------------------------------------------------------------
 # jit / vmap well-formedness
 # ---------------------------------------------------------------------------

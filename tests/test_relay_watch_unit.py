@@ -64,3 +64,15 @@ def test_relay_busy_idle_stack_is_not_busy():
     base = relay_watch.RELAY_PORT
     states = [(base, 0, "0A"), (base + 31, 0, "0A")]
     assert not relay_watch.relay_busy(states)
+
+
+def test_relay_busy_ignores_dev_server_below_relay_port():
+    # port-2 (8080 with the default relay port) is a common local HTTP
+    # port: a dev server there with one client must not defer the launch.
+    base = relay_watch.RELAY_PORT
+    states = [
+        (base, 0, "0A"),
+        (base - 2, 0, "0A"),
+        (51234, base - 2, "01"),
+    ]
+    assert not relay_watch.relay_busy(states)

@@ -90,6 +90,43 @@ def test_render_markdown_covers_all_sections():
     assert "256x256, batch 8)" in md and "120.0 images/sec/chip" in md
 
 
+def test_render_markdown_prefers_round_tagged_headline():
+    """A resumed round-3 session carries the round-2 train_bf16 entry AND
+    the fresh train_bf16_r3 one: the headline section must show the newest
+    round (with the older one as a 'previous round' line), and the batch-32
+    scaling point must render."""
+    report = _fake_report()
+    report["stages"]["train_bf16_r3"] = dict(
+        report["stages"]["train_bf16"],
+        value=520.0, vs_baseline=43.3, step_ms=30.7, preprocess_ms=4.0,
+    )
+    report["stages"]["train_bf16_batch32"] = {
+        "ok": True, "value": 600.0, "step_ms": 53.0, "mfu": 0.27,
+        "wall_sec": 150.0,
+    }
+    md = tpu_session._render_markdown(report)
+    assert "520.0 images/sec/chip" in md
+    assert "[stage `train_bf16_r3`]" in md
+    assert "previous round [`train_bf16`]: 480.0" in md
+    assert "Batch-scaling point (batch 32): **600.0 images/sec/chip**" in md
+
+
+def test_render_markdown_cpu_rehearsal_does_not_headline():
+    """An ok train_bf16_rN entry from a CPU rehearsal (--resume against the
+    committed report) must not displace the TPU-measured headline in the
+    measured-on-hardware doc — mirror of bench._last_measured_headline's
+    per-candidate device check."""
+    report = _fake_report()
+    report["stages"]["train_bf16"]["device_kind"] = "TPU v5 lite"
+    report["stages"]["train_bf16_r3"] = dict(
+        report["stages"]["train_bf16"], value=5.0, device_kind="cpu"
+    )
+    md = tpu_session._render_markdown(report)
+    assert "[stage `train_bf16`]" in md
+    assert "480.0 images/sec/chip" in md
+    assert "5.0 images/sec/chip" not in md
+
+
 def test_render_markdown_minimal_report():
     md = tpu_session._render_markdown(
         {"started_utc": "x", "stages": {"init": {"ok": False, "error": "e"}}}

@@ -23,6 +23,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # for the shared bench.relay_stack_busy
 
 # Primary relay listen port; keep in sync with bench._relay_listening.
 RELAY_PORT = int(os.environ.get("WATERNET_RELAY_PORT", "8082"))
@@ -65,19 +66,14 @@ def relay_busy(states=None) -> bool:
     set: 8082/83/87, 8092/93/97, ... 8112/13/117; the recorded session
     death involved the compile service on :8103 and a device connection on
     :8113), so a client can be mid-compile with no :8082 connection at all.
-    Busy = any ESTABLISHED connection whose endpoint is a port the relay
-    stack currently LISTENs on (ports near RELAY_PORT), which excludes
-    unrelated services outside that window."""
+    The window predicate itself lives in the stdlib-only
+    waternet_tpu.utils.platform.relay_stack_busy — one definition, shared
+    with the end-of-round bench's wait check, and importable by this
+    long-lived watcher without bench's heavy module-level dependencies."""
     states = _tcp_states() if states is None else states
-    stack_ports = {
-        lp
-        for lp, _, st in states
-        if st == "0A" and RELAY_PORT - 2 <= lp < RELAY_PORT + 38
-    }
-    return any(
-        st == "01" and (lp in stack_ports or rp in stack_ports)
-        for lp, rp, st in states
-    )
+    from waternet_tpu.utils.platform import relay_stack_busy
+
+    return relay_stack_busy(states, RELAY_PORT)
 
 
 def main():

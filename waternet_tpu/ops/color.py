@@ -19,9 +19,29 @@ OpenCV's 8-bit scaling convention (L in [0,255] via *255/100, a/b offset by
 +128); cv2's integer inverse differs by at most 3 levels on <0.003% of the
 full LAB-u8 cube (exhaustively characterized), and the host path remains
 the bit-exact-parity default.
+
+The inverse's linear->sRGB transfer has two device implementations,
+selected at trace time by ``WATERNET_SRGB_TRANSFER``:
+
+- ``poly`` (default): degree-10 Chebyshev-derived polynomial in
+  ``t = x**0.25`` — two ``sqrt`` plus an FMA chain, no transcendentals.
+  The TPU vector unit lowers ``pow`` to ``exp(log)`` (multi-cycle
+  transcendentals); sqrt+FMA is the cheap path, and the CPU per-op
+  breakdown (docs/RESULTS.md) showed the float inverse costing as much
+  as the whole CLAHE core. Approximation error is <4e-5 of one 8-bit
+  output level (fit characterized in tests), so disagreements with the
+  float path can occur only for inputs within float32 roundoff of a
+  rounding boundary: exhaustive LAB-cube characterization found the two
+  paths bit-identical except ±1 level on 4.5e-6 of the cube, leaving the
+  cv2 parity bound literally unchanged (max 3 levels, >1 level on
+  1.06e-5 of the cube — identical for both transfers).
+- ``float``: the literal ``1.055 * x**(1/2.4) - 0.055`` formula (the
+  round-1/2 device path), kept for on-hardware A/B measurement.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax.numpy as jnp
 import numpy as np
@@ -50,10 +70,61 @@ _LAB_T0 = 0.008856
 _LAB_K = 7.787
 
 
-def _linear_to_srgb(v):
-    return jnp.where(
-        v > 0.0031308, 1.055 * jnp.power(jnp.maximum(v, 0.0), 1.0 / 2.4) - 0.055, 12.92 * v
+_SRGB_CUT = 0.0031308
+
+
+def _build_srgb_poly():
+    """Degree-10 polynomial approximation of ``t -> t**(5/3)`` on
+    ``t in [cut**0.25, 1]``, power basis in the Chebyshev window variable
+    ``s = (2t - (1+a)) / (1-a)`` (well-conditioned; raw-``t`` monomial
+    coefficients cancel). With ``t = x**0.25``, ``p(s(t)) ~= x**(1/2.4)``:
+    float32 Horner error <= 1.6e-7, i.e. <4.2e-5 of one 8-bit level after
+    the 1.055/255 scaling — the same order as float32 ``pow`` itself.
+    """
+    a = _SRGB_CUT**0.25
+    ch = np.polynomial.chebyshev.Chebyshev.interpolate(
+        lambda t: t ** (5.0 / 3.0), 10, domain=[a, 1.0]
     )
+    coef = np.polynomial.chebyshev.cheb2poly(ch.coef).astype(np.float32)
+    scale = np.float32(2.0 / (1.0 - a))
+    offset = np.float32(-(1.0 + a) / (1.0 - a))
+    return coef, scale, offset
+
+
+_SRGB_POLY_COEF, _SRGB_POLY_SCALE, _SRGB_POLY_OFFSET = _build_srgb_poly()
+
+
+def _srgb_transfer_mode() -> str:
+    """Trace-time selection of the linear->sRGB transfer implementation.
+
+    ``poly`` (default) is the sqrt+FMA path; ``float`` is the literal
+    ``pow(x, 1/2.4)`` formula kept for A/B measurement. Unknown values are
+    an error: a typo must not silently change the measured path.
+    """
+    mode = os.environ.get("WATERNET_SRGB_TRANSFER", "poly").strip().lower()
+    if mode not in ("poly", "float"):
+        raise ValueError(
+            f"WATERNET_SRGB_TRANSFER={mode!r}: expected 'poly' or 'float'"
+        )
+    return mode
+
+
+def _linear_to_srgb(v):
+    if _srgb_transfer_mode() == "float":
+        return jnp.where(
+            v > _SRGB_CUT,
+            1.055 * jnp.power(jnp.maximum(v, 0.0), 1.0 / 2.4) - 0.055,
+            12.92 * v,
+        )
+    # poly: clamp to [cut, 1] (x > 1 is out-of-gamut and clips to 255
+    # downstream either way — p(1) = 1.0 exactly), substitute t = x**0.25
+    # (two sqrts), Horner in the window variable.
+    t = jnp.sqrt(jnp.sqrt(jnp.clip(v, _SRGB_CUT, 1.0)))
+    s = t * _SRGB_POLY_SCALE + _SRGB_POLY_OFFSET
+    acc = jnp.full_like(s, _SRGB_POLY_COEF[-1])
+    for k in range(len(_SRGB_POLY_COEF) - 2, -1, -1):
+        acc = acc * s + _SRGB_POLY_COEF[k]
+    return jnp.where(v > _SRGB_CUT, 1.055 * acc - 0.055, 12.92 * v)
 
 
 def _lab_f_inv(f):

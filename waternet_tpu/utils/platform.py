@@ -17,6 +17,28 @@ from __future__ import annotations
 import os
 
 
+def relay_stack_busy(states, port: int) -> bool:
+    """Pure predicate over parsed TCP states ``[(local_port, remote_port,
+    state_hex), ...]``: is any client ESTABLISHED into a port the relay
+    stack currently LISTENs on? The ONE place the stack window is defined —
+    bench.py's wait check and tools/relay_watch.py's launch gate both
+    delegate here, so a grid change cannot desynchronize them. Lives in
+    this stdlib-only module so the long-lived watcher never imports heavy
+    bench code at poll time.
+
+    The window starts AT the relay port: every observed stack service sits
+    at a non-negative offset (8082/83/87, +10 repeating, compile :8103 =
+    +21, device :8113 = +31). Reaching below (port-2 = 8080) would let an
+    unrelated dev server with one client stall the bench for its whole
+    wait budget."""
+    stack = {
+        lp for lp, _, st in states if st == "0A" and port <= lp < port + 38
+    }
+    return any(
+        st == "01" and (lp in stack or rp in stack) for lp, rp, st in states
+    )
+
+
 def enable_compile_cache() -> None:
     """Enable jax's persistent compilation cache (default: ~/.cache/...).
 
