@@ -205,21 +205,6 @@ def run_images_batched(
     flush()
 
 
-def run_image(engine, path: Path, savedir: Path, show_split: bool):
-    import cv2
-
-    bgr = cv2.imread(str(path))
-    if bgr is None:
-        print(f"Skipping unreadable image: {path}", file=sys.stderr)
-        return
-    rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
-    out_rgb = engine.enhance(rgb[None])[0]
-    out_bgr = cv2.cvtColor(out_rgb, cv2.COLOR_RGB2BGR)
-    savedir.mkdir(parents=True, exist_ok=True)
-    out = make_split(bgr, out_bgr) if show_split else out_bgr
-    cv2.imwrite(str(savedir / path.name), out)
-
-
 def run_video(engine, path: Path, savedir: Path, show_split: bool, batch_size: int):
     import cv2
 
@@ -302,10 +287,18 @@ def main(argv=None):
     )
 
     savedir = next_run_dir(Path(__file__).parent / "output", args.name)
+    # Images go through the shape-aware batched runner (same-shaped
+    # directories — the UIEB case — enhance in device batches under one
+    # compiled executable; a single file is just a batch of one). The
+    # reference enhances one image per step (`/root/reference/
+    # inference.py:166-233`).
+    image_files = [f for f in files if f.suffix.lower() in IM_SUFFIXES]
+    if image_files:
+        run_images_batched(
+            engine, image_files, savedir, args.show_split, args.batch_size
+        )
     for f in files:
-        if f.suffix.lower() in IM_SUFFIXES:
-            run_image(engine, f, savedir, args.show_split)
-        elif f.suffix.lower() in VID_SUFFIXES:
+        if f.suffix.lower() in VID_SUFFIXES:
             run_video(engine, f, savedir, args.show_split, args.batch_size)
     print(f"Saved output to {savedir}!")
 

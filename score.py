@@ -75,14 +75,32 @@ def parse_args(argv=None):
         "--raw-dir", type=str,
         help="Score a directory of raw images with NO references (e.g. UIEB "
         "challenging-60) using no-reference metrics (UCIQE/UIQM), before and "
-        "after enhancement. Paired metrics are skipped in this mode.",
+        "after enhancement, at native resolution (images batched by shape). "
+        "Paired metrics are skipped in this mode.",
+    )
+    p.add_argument(
+        "--nr-resize", action="store_true",
+        help="(with --raw-dir) resize raw images to --height x --width "
+        "before scoring instead of native resolution. UCIQE/UIQM are "
+        "resolution-sensitive (UISM/UIConM are block-based), so resized "
+        "values are NOT comparable to native-resolution literature numbers "
+        "— use only to compare two checkpoints at a fixed size cheaply.",
     )
     return p.parse_args(argv)
 
 
 def score_no_reference(args):
     """Challenging-60-style scoring: no ground truth exists, so report
-    UCIQE/UIQM on the raw inputs and on the enhanced outputs."""
+    UCIQE/UIQM on the raw inputs and on the enhanced outputs.
+
+    Default is NATIVE resolution, images grouped by shape so each distinct
+    shape compiles one executable and same-shaped images run in device
+    batches: UCIQE/UIQM are resolution-sensitive (block-based UISM/UIConM),
+    so numbers at a forced resize are not comparable to native-resolution
+    literature values. ``--nr-resize`` restores the fixed-size behavior
+    (and its caveat) for cheap checkpoint-to-checkpoint comparison.
+    """
+    import sys
     from pathlib import Path
 
     import cv2
@@ -90,6 +108,7 @@ def score_no_reference(args):
     import numpy as np
 
     from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.parallel.mesh import pad_to_multiple
     from waternet_tpu.training.metrics_nr import uciqe_batch, uiqm_batch
 
     files = sorted(
@@ -103,35 +122,56 @@ def score_no_reference(args):
         device_preprocess=args.device_preprocess,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
     )
-    import sys
+
+    # Pass 1: group file PATHS by shape (decode-and-discard keeps host
+    # memory bounded at one batch — raw-890 at native resolution would be
+    # gigabytes if held at once). Insertion-ordered, so output order is
+    # deterministic; with --nr-resize everything lands in one group.
+    groups: dict = {}
+    for f in files:
+        bgr = cv2.imread(str(f))
+        if bgr is None:
+            print(f"Skipping unreadable image: {f}", file=sys.stderr)
+            continue
+        shape = (
+            (args.height, args.width, 3) if args.nr_resize else bgr.shape
+        )
+        groups.setdefault(shape, []).append(f)
 
     sums = {"uciqe_raw": 0.0, "uiqm_raw": 0.0, "uciqe_enhanced": 0.0, "uiqm_enhanced": 0.0}
     n_scored = 0
-    for start in range(0, len(files), args.batch_size):
-        chunk = files[start : start + args.batch_size]
-        raws = []
-        for f in chunk:
-            bgr = cv2.imread(str(f))
-            if bgr is None:
-                print(f"Skipping unreadable image: {f}", file=sys.stderr)
+    for paths in groups.values():
+        for start in range(0, len(paths), args.batch_size):
+            chunk = paths[start : start + args.batch_size]
+            raws = []
+            for f in chunk:
+                bgr = cv2.imread(str(f))
+                if bgr is None:  # readable in pass 1, vanished since
+                    print(f"Skipping unreadable image: {f}", file=sys.stderr)
+                    continue
+                if args.nr_resize:
+                    bgr = cv2.resize(bgr, (args.width, args.height))
+                raws.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
+            if not raws:
                 continue
-            bgr = cv2.resize(bgr, (args.width, args.height))
-            raws.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
-        if not raws:
-            continue
-        # Pad the final partial chunk so jit compiles one batch shape only.
-        from waternet_tpu.parallel.mesh import pad_to_multiple
-
-        raw, n_real = pad_to_multiple(np.stack(raws), args.batch_size)
-        out = engine.enhance(raw)
-        for key, batch in (
-            ("uciqe_raw", uciqe_batch(jnp.asarray(raw))),
-            ("uiqm_raw", uiqm_batch(jnp.asarray(raw))),
-            ("uciqe_enhanced", uciqe_batch(jnp.asarray(out))),
-            ("uiqm_enhanced", uiqm_batch(jnp.asarray(out))),
-        ):
-            sums[key] += float(np.asarray(batch)[:n_real].sum())
-        n_scored += n_real
+            if len(raws) < args.batch_size and len(paths) > args.batch_size:
+                # Tail of a multi-batch group: pad so it reuses the full
+                # batch's compiled executable instead of compiling anew.
+                raw, n_real = pad_to_multiple(np.stack(raws), args.batch_size)
+            else:
+                # Group fits in one batch: padding would only multiply
+                # compute (its shape compiles exactly one program either
+                # way, the common case for unique-resolution directories).
+                raw, n_real = np.stack(raws), len(raws)
+            out = engine.enhance(raw)
+            for key, batch in (
+                ("uciqe_raw", uciqe_batch(jnp.asarray(raw))),
+                ("uiqm_raw", uiqm_batch(jnp.asarray(raw))),
+                ("uciqe_enhanced", uciqe_batch(jnp.asarray(out))),
+                ("uiqm_enhanced", uiqm_batch(jnp.asarray(out))),
+            ):
+                sums[key] += float(np.asarray(batch)[:n_real].sum())
+            n_scored += n_real
     if n_scored == 0:
         raise FileNotFoundError(f"no readable images in {args.raw_dir}")
     return {k: v / n_scored for k, v in sums.items()} | {"images": n_scored}

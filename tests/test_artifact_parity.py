@@ -1,0 +1,180 @@
+"""Conditional parity tests against REAL released artifacts.
+
+This environment ships neither the reference's released checkpoint
+(``waternet_exported_state_dict-daa0ee.pt``, `/root/reference/hubconf.py:5`)
+nor torchvision's VGG19 weights (``vgg19-dcbb9e9d.pth``) nor the UIEB
+dataset — all three were searched for and absent in rounds 1-3. These tests
+probe the conventional locations and SKIP when the artifact is missing, so
+the moment one appears (mounted, copied, or downloaded via ``inference.py
+--download``) the parity evidence is captured by a plain ``pytest`` run with
+zero extra work.
+
+Expected numbers when everything is present:
+* daa0ee forward parity vs the independent torch functional forward used by
+  test_convert (atol 2e-5 — same bound the random-weights round-trip meets);
+* the replication table `/root/reference/README.md:146-151`: SSIM 0.92 /
+  PSNR 21.8 on the seed-0 90-image val split at 112x112 (we assert the
+  looser >=0.90 / >=21.0: this scorer evaluates unaugmented, see score.py).
+"""
+
+import hashlib
+import os
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def _find_artifact(patterns, extra_dirs=(), env_var=None):
+    """First existing file matching any glob pattern in the conventional
+    weight locations (repo cwd, weights/, torch hub cache)."""
+    if env_var and os.environ.get(env_var):
+        p = Path(os.environ[env_var])
+        if p.exists():
+            return p
+    dirs = [
+        Path("."),
+        Path("weights"),
+        Path.home() / ".cache" / "torch" / "hub" / "checkpoints",
+        *map(Path, extra_dirs),
+    ]
+    for d in dirs:
+        if not d.is_dir():
+            continue
+        for pat in patterns:
+            hits = sorted(d.glob(pat))
+            if hits:
+                return hits[0]
+    return None
+
+
+def _daa0ee_path():
+    return _find_artifact(["waternet_exported_state_dict*daa0ee*.pt"])
+
+
+def _vgg19_path():
+    # torchvision's released file is vgg19-dcbb9e9d.pth; accept any vgg19
+    # torch file in the locations models/vgg.resolve_vgg_params scans.
+    return _find_artifact(
+        ["vgg19*.pth", "vgg19*.pt"], env_var="WATERNET_TPU_VGG"
+    )
+
+
+def _uieb_root():
+    for root in (Path("data"), Path("/root/data"), Path("/data")):
+        if (root / "raw-890").is_dir() and (root / "reference-890").is_dir():
+            return root
+    return None
+
+
+needs_daa0ee = pytest.mark.skipif(
+    _daa0ee_path() is None,
+    reason="reference checkpoint daa0ee not present in this environment",
+)
+needs_vgg19 = pytest.mark.skipif(
+    _vgg19_path() is None,
+    reason="torchvision VGG19 weights not present in this environment",
+)
+
+
+@needs_daa0ee
+def test_daa0ee_hash_matches_release_contract():
+    """The file on disk is the real release: its sha256 starts with the
+    daa0ee prefix embedded in the reference's checkpoint filename
+    (`/root/reference/hubconf.py:5`; torch.hub check_hash semantics)."""
+    path = _daa0ee_path()
+    digest = hashlib.sha256(path.read_bytes()).hexdigest()
+    assert digest.startswith("daa0ee"), (path, digest[:12])
+
+
+@needs_daa0ee
+def test_daa0ee_conversion_and_forward_parity():
+    """Released checkpoint -> Flax params: full key/shape coverage and
+    forward parity against the independent torch functional forward."""
+    from tests.test_convert import _torch_forward
+    from waternet_tpu.models import WaterNet
+    from waternet_tpu.utils.torch_port import waternet_params_from_torch
+
+    path = _daa0ee_path()
+    params = waternet_params_from_torch(path)
+    import jax
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert n_params == 1_090_668
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    rng = np.random.default_rng(0)
+    x, wb, ce, gc = (
+        rng.random((1, 32, 32, 3)).astype(np.float32) for _ in range(4)
+    )
+    want = _torch_forward(
+        sd,
+        *(torch.from_numpy(a.transpose(0, 3, 1, 2)) for a in (x, wb, ce, gc)),
+    ).numpy().transpose(0, 2, 3, 1)
+
+    import jax.numpy as jnp
+
+    got = np.asarray(
+        WaterNet().apply(
+            params, jnp.asarray(x), jnp.asarray(wb), jnp.asarray(ce),
+            jnp.asarray(gc),
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=1e-5)
+
+
+@needs_vgg19
+def test_real_vgg19_forward_parity():
+    """Real torchvision VGG19 weights through our converter match the
+    independent torch forward of the same state_dict (relu5_4 cut)."""
+    from tests.test_vgg import _torch_vgg_forward
+    from waternet_tpu.models.vgg import VGG19Features
+    from waternet_tpu.utils.torch_port import vgg19_params_from_torch
+
+    path = _vgg19_path()
+    params = vgg19_params_from_torch(path)
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+
+    rng = np.random.default_rng(0)
+    x = rng.random((1, 32, 32, 3)).astype(np.float32)
+    want = _torch_vgg_forward(
+        sd, torch.from_numpy(x.transpose(0, 3, 1, 2))
+    ).numpy().transpose(0, 2, 3, 1)
+
+    import jax.numpy as jnp
+
+    got = np.asarray(VGG19Features().apply(params, jnp.asarray(x)))
+    # Real ImageNet weights produce activations O(1e2) at relu5_4;
+    # rtol-dominated bound instead of the random-weights atol.
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
+@needs_daa0ee
+def test_uieb_replication_table(tmp_path):
+    """THE reference evidence: score daa0ee on the seed-0 val split at
+    112x112 and meet the replication table (`/root/reference/
+    README.md:146-151`, produced by `/root/reference/score.py:84-177`).
+    Needs checkpoint + UIEB data; VGG19 only affects perceptual_loss, so
+    its absence does not gate the SSIM/PSNR assertion."""
+    if _uieb_root() is None:
+        pytest.skip("UIEB dataset (raw-890/reference-890) not present")
+    import json
+
+    import score as cli
+
+    out = tmp_path / "artifact_replication.json"
+    argv = [
+        "--weights", str(_daa0ee_path()),
+        "--data-root", str(_uieb_root()),
+        "--json-out", str(out),
+    ]
+    vgg = _vgg19_path()
+    if vgg is not None:
+        argv += ["--vgg-weights", str(vgg)]
+    cli.main(argv)
+    metrics = json.loads(out.read_text())
+    # Reference reports 0.92 / 21.8; unaugmented eval justifies the slack.
+    assert metrics["ssim"] >= 0.90, metrics
+    assert metrics["psnr"] >= 21.0, metrics

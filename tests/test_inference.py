@@ -250,3 +250,66 @@ def test_cli_image_roundtrip(random_params, tmp_path, monkeypatch, sample_rgb):
     assert out_path.exists()
     out_im = cv2.imread(str(out_path))
     assert out_im.shape == sample_rgb.shape
+
+
+def test_cli_directory_batches_images_by_shape(
+    random_params, tmp_path, monkeypatch, rng
+):
+    """Directory image sources run through the shape-aware batched path:
+    consecutive same-shaped files stack into device batches of up to
+    --batch-size, a shape change flushes the pending batch, and unreadable
+    files are skipped without killing the run (reference behavior is one
+    image per step: /root/reference/inference.py:166-233)."""
+    cv2 = pytest.importorskip("cv2")
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    import inference as cli
+
+    weights = tmp_path / "w.npz"
+    save_weights(random_params, weights)
+
+    src = tmp_path / "imgs"
+    src.mkdir()
+
+    def write(name, h, w):
+        im = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        cv2.imwrite(str(src / name), im)
+
+    # Sorted order: three 32x32, then a 48x32, then a 32x32 straggler.
+    write("a1.png", 32, 32)
+    write("a2.png", 32, 32)
+    write("a3.png", 32, 32)
+    write("b.png", 48, 32)
+    write("c.png", 32, 32)
+    (src / "broken.png").write_bytes(b"not a png")
+
+    batch_shapes = []
+    orig = InferenceEngine.enhance
+
+    def recording(self, frames):
+        batch_shapes.append(tuple(frames.shape))
+        return orig(self, frames)
+
+    monkeypatch.setattr(InferenceEngine, "enhance", recording)
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir",
+        lambda base, name=None: tmp_path / "out",
+    )
+    cli.main(
+        ["--source", str(src), "--weights", str(weights), "--batch-size", "2"]
+    )
+
+    for name, shape in (
+        ("a1.png", (32, 32, 3)), ("a2.png", (32, 32, 3)),
+        ("a3.png", (32, 32, 3)), ("b.png", (48, 32, 3)),
+        ("c.png", (32, 32, 3)),
+    ):
+        out = cv2.imread(str(tmp_path / "out" / name))
+        assert out is not None and out.shape == shape, name
+    assert not (tmp_path / "out" / "broken.png").exists()
+    # a1+a2 batch (size cap), a3 flushed by b's shape change, then b, c.
+    assert batch_shapes == [
+        (2, 32, 32, 3), (1, 32, 32, 3), (1, 48, 32, 3), (1, 32, 32, 3),
+    ]

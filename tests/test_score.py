@@ -53,14 +53,15 @@ def test_score_paired_roundtrip(weights_file, uieb_root, tmp_path):
 
 
 def test_score_nr_mode(weights_file, uieb_root, tmp_path):
-    """--raw-dir scores unpaired images with UCIQE/UIQM before/after —
-    the capability the reference lacks for UIEB's Challenging-60 split."""
+    """--raw-dir --nr-resize scores unpaired images with UCIQE/UIQM
+    before/after at a forced size — the cheap checkpoint-comparison mode
+    (native resolution is the default, covered separately)."""
     import score as cli
 
     out = tmp_path / "nr.json"
     cli.main([
         "--weights", str(weights_file), "--raw-dir", str(uieb_root / "raw-890"),
-        "--height", "32", "--width", "32", "--batch-size", "4",
+        "--height", "32", "--width", "32", "--batch-size", "4", "--nr-resize",
         "--json-out", str(out),
     ])
     metrics = json.loads(out.read_text())
@@ -68,4 +69,32 @@ def test_score_nr_mode(weights_file, uieb_root, tmp_path):
         "uciqe_raw", "uiqm_raw", "uciqe_enhanced", "uiqm_enhanced", "images",
     }
     assert metrics["images"] == 6
+    assert all(np.isfinite(v) for v in metrics.values())
+
+
+def test_score_nr_native_resolution_mixed_shapes(weights_file, tmp_path, rng):
+    """Default --raw-dir scoring runs at NATIVE resolution with images
+    grouped by shape (UCIQE/UIQM are block-based and resolution-sensitive;
+    forced-resize numbers aren't comparable to literature values). A
+    mixed-shape directory must score every readable image once."""
+    import cv2
+
+    import score as cli
+
+    raw = tmp_path / "challenging"
+    raw.mkdir()
+    for i, (h, w) in enumerate([(40, 52), (40, 52), (40, 52), (64, 48), (64, 48)]):
+        cv2.imwrite(
+            str(raw / f"{i:03d}.png"),
+            rng.integers(0, 256, (h, w, 3), dtype=np.uint8),
+        )
+    (raw / "bad.png").write_bytes(b"junk")
+
+    out = tmp_path / "nr_native.json"
+    cli.main([
+        "--weights", str(weights_file), "--raw-dir", str(raw),
+        "--batch-size", "2", "--json-out", str(out),
+    ])
+    metrics = json.loads(out.read_text())
+    assert metrics["images"] == 5
     assert all(np.isfinite(v) for v in metrics.values())

@@ -247,6 +247,43 @@ def test_device_cached_epoch_matches_host_fed():
         assert e_host[k] == pytest.approx(e_cached[k], rel=1e-5)
 
 
+def test_val_cache_not_aliased_across_datasets():
+    """Two different datasets with identical index sets must not share the
+    memoized val cache (the old id()-based key could alias after GC reuse;
+    the token key can't: tokens are monotonic and never reused)."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import _cache_token
+
+    n, bs, hw = 4, 2, 32
+    cfg = TrainConfig(
+        batch_size=bs, im_height=hw, im_width=hw, precision="fp32",
+        perceptual_weight=0.0, shuffle=False, augment=False,
+    )
+    idx = np.arange(n)
+    engine = TrainingEngine(cfg)
+    ds_a = SyntheticPairs(n, hw, hw, seed=0)
+    ds_b = SyntheticPairs(n, hw, hw, seed=123)
+
+    e_a = engine.eval_epoch_cached(dataset=ds_a, indices=idx)
+    e_b = engine.eval_epoch_cached(dataset=ds_b, indices=idx)
+    assert e_a["mse"] != pytest.approx(e_b["mse"])
+    # Memoization still works for a repeated (dataset, indices) pair.
+    assert engine.eval_epoch_cached(dataset=ds_b, indices=idx) == e_b
+
+    # Token mechanics: stable per object, strictly increasing across new
+    # objects — a recycled id() can never resurrect an old cache entry.
+    assert _cache_token(ds_a) == _cache_token(ds_a)
+    assert _cache_token(ds_b) > _cache_token(ds_a)
+    assert _cache_token(SyntheticPairs(2, hw, hw)) > _cache_token(ds_b)
+
+    # A deepcopy must be a NEW identity (the weak-key map doesn't travel
+    # with the object): a copied-then-mutated dataset can't serve the
+    # original's cache.
+    import copy
+
+    assert _cache_token(copy.deepcopy(ds_a)) != _cache_token(ds_a)
+
+
 def test_device_cached_tail_batch_masked():
     """n not divisible by batch: the tail gathers repeated indices but
     masks them out — epoch metrics must match the host-fed tail handling."""

@@ -23,7 +23,7 @@ import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-sys.path.insert(0, str(REPO))  # for the shared bench.relay_stack_busy
+sys.path.insert(0, str(REPO))  # for waternet_tpu.utils.platform.relay_stack_busy
 
 # Primary relay listen port; keep in sync with bench._relay_listening.
 RELAY_PORT = int(os.environ.get("WATERNET_RELAY_PORT", "8082"))

@@ -24,6 +24,7 @@ same code path runs single-chip (trivial 1-device mesh).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Any, Optional
 
 import jax
@@ -52,6 +53,36 @@ from waternet_tpu.training.metrics import ssim as ssim_fn
 
 TRAIN_METRICS_NAMES = ["mse", "ssim", "psnr", "perceptual_loss", "loss"]
 VAL_METRICS_NAMES = ["mse", "ssim", "psnr", "perceptual_loss"]
+
+_CACHE_TOKEN_COUNTER = itertools.count()
+_CACHE_TOKENS: "weakref.WeakKeyDictionary" = None  # built on first use
+
+
+def _cache_token(obj) -> int:
+    """Monotonic identity token for memo keys, tracked in a weak-key map.
+
+    ``id()`` is unusable as a cache key: CPython reuses addresses after GC,
+    so a freed object replaced by a new one at the same address would
+    silently alias its cache entry. Tokens from this counter are never
+    reused, and the weak-key map (rather than stamping an attribute on the
+    object) means a ``deepcopy``/unpickle of a tokened dataset is a NEW
+    key — a copied-then-mutated dataset cannot serve the original's cache.
+    Non-weakrefable objects get a fresh token per call — always-rebuild,
+    which is slow but never stale.
+    """
+    global _CACHE_TOKENS
+    if _CACHE_TOKENS is None:
+        import weakref
+
+        _CACHE_TOKENS = weakref.WeakKeyDictionary()
+    tok = _CACHE_TOKENS.get(obj)
+    if tok is None:
+        tok = next(_CACHE_TOKEN_COUNTER)
+        try:
+            _CACHE_TOKENS[obj] = tok
+        except TypeError:
+            pass
+    return tok
 
 
 @dataclasses.dataclass
@@ -482,9 +513,12 @@ class TrainingEngine:
     def eval_epoch_cached(self, dataset=None, indices=None) -> dict:
         """Eval over a device-resident cache. With dataset/indices given,
         builds (and memoizes) a val cache keyed on exactly those indices —
-        a different dataset or index set rebuilds it."""
+        a different dataset or index set rebuilds it. Identity comes from
+        :func:`_cache_token`, not ``id()``: CPython reuses object ids after
+        GC, so a freed dataset replaced by a new same-indexed one at the
+        same address must not serve the stale cache."""
         if dataset is not None:
-            key = (id(dataset), tuple(int(i) for i in indices))
+            key = (_cache_token(dataset), tuple(int(i) for i in indices))
             if getattr(self, "_val_cache_key", None) != key:
                 self._val_cache = self._build_cache(dataset, indices)
                 self._val_cache_key = key
