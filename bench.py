@@ -249,7 +249,7 @@ def measure_preprocess_breakdown(batch=16, hw=112, steps=30):
 
 def measure_train(
     batch=None, hw=None, precision=None, warmup=None, steps=None,
-    **config_overrides,
+    device_cache=False, **config_overrides,
 ):
     """The headline measurement: one fused train step (on-device augment +
     WB/GC/CLAHE + WaterNet + VGG fwd/bwd + Adam + metrics), AOT-compiled
@@ -257,7 +257,13 @@ def measure_train(
     it). Module-level env defaults apply when args are None so the CLI and
     library callers (tools/tpu_session.py, tools/host_bench.py) share one
     code path; extra kwargs pass through to TrainConfig (e.g.
-    ``perceptual_weight=0.0`` for a no-VGG arm)."""
+    ``perceptual_weight=0.0`` for a no-VGG arm).
+
+    ``device_cache=True`` measures the HBM-resident path instead (the
+    ``--device-cache`` trainer): batch gather from the pinned dataset and,
+    with the default ``precache_histeq``, zero in-step classical
+    transforms (WB/GC augmented from caches, CLAHE from the dihedral
+    variant table)."""
     batch = BATCH if batch is None else batch
     hw = HW if hw is None else hw
     precision = PRECISION if precision is None else precision
@@ -286,25 +292,47 @@ def measure_train(
     rng = jax.random.PRNGKey(0)
     n_real = jnp.asarray(batch, jnp.int32)
 
+    if device_cache:
+        cache_build_t0 = time.perf_counter()
+        engine.cache_dataset(data, idx)
+        cache_build_s = time.perf_counter() - cache_build_t0
+        idx_b, n_real_i = next(
+            engine._cached_index_batches(len(data), epoch=0, shuffle=False)
+        )
+        idx_d = engine._replicate_global(idx_b)
+        n_real = jnp.asarray(n_real_i, jnp.int32)
+        if engine._cache_he is not None:
+            step_fn = engine.train_step_cached_pre
+            step_args = (
+                engine._cache_raw, engine._cache_ref, engine._cache_wb,
+                engine._cache_gc, engine._cache_he, idx_d, rng, n_real,
+            )
+        else:
+            step_fn = engine.train_step_cached
+            step_args = (
+                engine._cache_raw, engine._cache_ref, idx_d, rng, n_real,
+            )
+    else:
+        step_fn = engine.train_step
+        step_args = (raw_d, ref_d, rng, n_real)
+
     # AOT-compile the full fused step once (preprocess + WaterNet + VGG
     # fwd/bwd + Adam + metrics); the same executable provides XLA's FLOP
     # count AND runs the measured loop, so the step is compiled exactly once.
     t0 = time.perf_counter()
-    compiled_step = engine.train_step.lower(
-        engine.state, raw_d, ref_d, rng, n_real
-    ).compile()
+    compiled_step = step_fn.lower(engine.state, *step_args).compile()
     compile_s = time.perf_counter() - t0
     step_tflop = _compiled_tflops(compiled_step)
 
     state = engine.state
     if warmup:
         for i in range(warmup):
-            state, m = compiled_step(state, raw_d, ref_d, rng, n_real)
+            state, m = compiled_step(state, *step_args)
         jax.block_until_ready(m["loss"])
 
     t0 = time.perf_counter()
     for i in range(steps):
-        state, m = compiled_step(state, raw_d, ref_d, rng, n_real)
+        state, m = compiled_step(state, *step_args)
     jax.block_until_ready(m["loss"])
     dt = time.perf_counter() - t0
     step_s = dt / steps
@@ -354,6 +382,10 @@ def measure_train(
     line["clahe_hist"] = _hist_mode(None)
     line["clahe_interp"] = _interp_mode(hw // ty, hw // tx)
     line["srgb_transfer"] = _srgb_transfer_mode()
+    if device_cache:
+        line["device_cache"] = True
+        line["precache_histeq"] = engine._cache_he is not None
+        line["cache_build_sec"] = round(cache_build_s, 2)
     return line
 
 

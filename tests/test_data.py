@@ -149,3 +149,42 @@ def test_augment_nonsquare_shape_preserved():
     raw = np.random.default_rng(0).random((4, 12, 20, 3)).astype(np.float32)
     raw_a, _ = augment_pair_batch(jax.random.PRNGKey(1), raw, raw)
     assert raw_a.shape == raw.shape
+
+
+def test_dihedral_decomposition_matches_augment():
+    """Every (hflip, vflip, rotk) draw equals dihedral_apply at the index
+    dihedral_variant_index reports — the invariant the precached-CLAHE
+    trainer path rests on (table built with dihedral_apply, selected by
+    the step's draws)."""
+    import jax.numpy as jnp
+
+    from waternet_tpu.data.augment import (
+        apply_augment_batch,
+        dihedral_apply,
+        dihedral_variant_count,
+        dihedral_variant_index,
+    )
+
+    rng = np.random.default_rng(3)
+    for shape in ((10, 10), (8, 12)):
+        square = shape[0] == shape[1]
+        img = rng.integers(0, 256, (2, *shape, 3)).astype(np.float32)
+        seen = set()
+        for h in (0, 1):
+            for v in (0, 1):
+                for k in range(4):
+                    hf = jnp.full((2,), bool(h))
+                    vf = jnp.full((2,), bool(v))
+                    rk = jnp.full((2,), k, jnp.int32)
+                    want = np.asarray(apply_augment_batch(img, hf, vf, rk))
+                    idx = int(
+                        np.asarray(
+                            dihedral_variant_index(hf, vf, rk, square)
+                        )[0]
+                    )
+                    seen.add(idx)
+                    got = np.asarray(
+                        dihedral_apply(jnp.asarray(img), idx, square)
+                    )
+                    np.testing.assert_array_equal(want, got, err_msg=str((shape, h, v, k)))
+        assert seen == set(range(dihedral_variant_count(*shape)))

@@ -284,6 +284,38 @@ def test_val_cache_not_aliased_across_datasets():
     assert _cache_token(copy.deepcopy(ds_a)) != _cache_token(ds_a)
 
 
+def test_precache_histeq_matches_in_step_transform():
+    """precache_histeq=True (transforms hoisted to cache-build time, CLAHE
+    via the dihedral variant table) must train identically to the in-step
+    transform path — same draws, same math, augmentation ON so every
+    variant-selection branch is exercised."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    n, bs, hw = 8, 4, 32
+    cfg = dict(
+        batch_size=bs, im_height=hw, im_width=hw, precision="fp32",
+        perceptual_weight=0.0, shuffle=True, augment=True,
+    )
+    ds = SyntheticPairs(n, hw, hw, seed=0)
+    idx = np.arange(n)
+
+    pre = TrainingEngine(TrainConfig(precache_histeq=True, **cfg))
+    pre.cache_dataset(ds, idx)
+    assert pre._cache_he is not None and pre._cache_he.shape[0] == 8
+
+    plain = TrainingEngine(TrainConfig(precache_histeq=False, **cfg))
+    plain.cache_dataset(ds, idx)
+    assert plain._cache_he is None
+
+    for epoch in range(2):
+        m_pre = pre.train_epoch_cached(epoch=epoch)
+        m_plain = plain.train_epoch_cached(epoch=epoch)
+        for k in m_plain:
+            assert m_pre[k] == pytest.approx(m_plain[k], rel=1e-5), (
+                epoch, k, m_pre[k], m_plain[k],
+            )
+
+
 def test_device_cached_tail_batch_masked():
     """n not divisible by batch: the tail gathers repeated indices but
     masks them out — epoch metrics must match the host-fed tail handling."""

@@ -71,12 +71,12 @@ def augment_pair_np(rng, raw, ref):
     return raw, ref
 
 
-def augment_pair_batch(rng: jax.Array, raw: jnp.ndarray, ref: jnp.ndarray):
-    """Paired random flips/rot90 for an (N, H, W, C) batch.
+def draw_augment(rng: jax.Array, n: int):
+    """Per-image augmentation draws: (hflip, vflip, rotk).
 
-    Returns (raw_aug, ref_aug) float32 with the same uint8 values.
-    """
-    n = raw.shape[0]
+    Split out of :func:`augment_pair_batch` so callers that must act on the
+    SAME draws (e.g. the precached-CLAHE step selecting a dihedral variant)
+    consume an identical random stream."""
     k_h, k_v, k_r, k_rk = jax.random.split(rng, 4)
     hflip = jax.random.bernoulli(k_h, 0.5, (n,))
     vflip = jax.random.bernoulli(k_v, 0.5, (n,))
@@ -85,8 +85,77 @@ def augment_pair_batch(rng: jax.Array, raw: jnp.ndarray, ref: jnp.ndarray):
     rotk = jnp.where(
         do_rot, jax.random.randint(k_rk, (n,), 0, 4), 0
     ).astype(jnp.int32)
+    return hflip, vflip, rotk
 
-    raw = raw.astype(jnp.float32)
-    ref = ref.astype(jnp.float32)
-    aug = jax.vmap(_apply_one)
-    return aug(raw, hflip, vflip, rotk), aug(ref, hflip, vflip, rotk)
+
+def apply_augment_batch(imgs: jnp.ndarray, hflip, vflip, rotk) -> jnp.ndarray:
+    """Apply per-image draws to an (N, H, W, C) batch -> float32."""
+    return jax.vmap(_apply_one)(imgs.astype(jnp.float32), hflip, vflip, rotk)
+
+
+def augment_pair_batch(rng: jax.Array, raw: jnp.ndarray, ref: jnp.ndarray):
+    """Paired random flips/rot90 for an (N, H, W, C) batch.
+
+    Returns (raw_aug, ref_aug) float32 with the same uint8 values.
+    """
+    hflip, vflip, rotk = draw_augment(rng, raw.shape[0])
+    return (
+        apply_augment_batch(raw, hflip, vflip, rotk),
+        apply_augment_batch(ref, hflip, vflip, rotk),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Dihedral decomposition of the (hflip, vflip, rotk) composite.
+#
+# The augment composite applied by _apply_one is R^k . V^v . H^h (hflip
+# first). Group identities (verified exhaustively against _apply_one):
+#   square:      R^k . V^v . H^h  ==  R^{(k+2v)%4} . H^{(h+v)%2}
+#   non-square (rot degraded to 180 iff k==2, with r := [k==2]):
+#                ==  V^{(v+r)%2} . H^{(h+r)%2}
+# so every reachable augmentation is one of 8 (square) / 4 (non-square)
+# canonical variants. The precached-CLAHE path stores `histeq` of each
+# canonical variant and selects by this index at step time — CLAHE does NOT
+# commute with flips (tile interpolation has a half-pixel offset), so the
+# variant table is how it is hoisted out of the step bit-exactly.
+# ---------------------------------------------------------------------------
+
+
+def dihedral_variant_count(h: int, w: int) -> int:
+    return 8 if h == w else 4
+
+
+def dihedral_variant_index(hflip, vflip, rotk, square: bool):
+    """Per-image canonical variant index for given draws (int32 array).
+
+    square:      refl*4 + rot with refl=(h+v)%2, rot=(k+2v)%4  (0..7)
+    non-square:  hh*2 + vv   with r=[k==2], hh=(h+r)%2, vv=(v+r)%2 (0..3)
+    """
+    h = hflip.astype(jnp.int32)
+    v = vflip.astype(jnp.int32)
+    if square:
+        refl = (h + v) % 2
+        rot = (rotk + 2 * v) % 4
+        return refl * 4 + rot
+    r = (rotk == 2).astype(jnp.int32)
+    hh = (h + r) % 2
+    vv = (v + r) % 2
+    return hh * 2 + vv
+
+
+def dihedral_apply(imgs, variant: int, square: bool):
+    """Apply canonical variant ``variant`` (a static int) to (N, H, W, C).
+
+    Inverse-free enumeration helper for building the variant table; works
+    on numpy or jax arrays (pure slicing/rot90)."""
+    if square:
+        refl, rot = divmod(variant, 4)
+        out = imgs[:, :, ::-1, :] if refl else imgs
+        if rot:
+            out = jnp.rot90(out, rot, axes=(1, 2))
+        return out
+    hh, vv = divmod(variant, 2)
+    out = imgs[:, :, ::-1, :] if hh else imgs
+    if vv:
+        out = out[:, ::-1, :, :]
+    return out
