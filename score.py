@@ -38,6 +38,75 @@ from pprint import pprint
 import numpy as np
 
 
+def _image_shape(path) -> "tuple[int, int, int] | None":
+    """``(h, w, 3)`` from the file header alone — no pixel decode.
+
+    Pass 1 of no-reference scoring only needs shapes to GROUP files; the
+    previous implementation ran ``cv2.imread`` per file, decoding every
+    pixel in the directory twice per scoring run (raw-890 at native
+    resolution is gigabytes). This reads <=64 bytes for PNG/BMP and the
+    marker chain for JPEG — the three containers ``score_no_reference``
+    globs (.png/.jpg/.jpeg/.bmp). Returns ``None`` when the header can't
+    be parsed so the caller falls back to a full decode; channel count is
+    pinned to 3 because ``cv2.imread``'s default flag decodes to 3-channel
+    BGR regardless of the file's own channel count. NOTE: for JPEGs with
+    an EXIF orientation tag cv2 rotates at decode time, so the decoded
+    shape can be the transpose of the header's — the scoring worklist
+    re-queues such files under the decoded shape.
+    """
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(32)
+            if head[:8] == b"\x89PNG\r\n\x1a\n" and head[12:16] == b"IHDR":
+                w = int.from_bytes(head[16:20], "big")
+                h = int.from_bytes(head[20:24], "big")
+                return (h, w, 3) if h > 0 and w > 0 else None
+            if head[:2] == b"BM" and len(head) >= 26:
+                # BITMAPINFOHEADER: int32 width/height at 18/22; height<0
+                # means top-down row order, same pixel dimensions.
+                w = int.from_bytes(head[18:22], "little", signed=True)
+                h = int.from_bytes(head[22:26], "little", signed=True)
+                return (abs(h), abs(w), 3) if h != 0 and w > 0 else None
+            if head[:2] == b"\xff\xd8":  # JPEG: walk markers to SOFn
+                fh.seek(2)
+                while True:
+                    b = fh.read(1)
+                    if not b:
+                        return None
+                    if b != b"\xff":
+                        continue
+                    marker = fh.read(1)
+                    while marker == b"\xff":  # legal fill bytes
+                        marker = fh.read(1)
+                    if not marker:
+                        return None
+                    m = marker[0]
+                    # Standalone markers (no length field): TEM, RSTn, SOI.
+                    if m == 0x01 or 0xD0 <= m <= 0xD8:
+                        continue
+                    if m == 0xD9:  # EOI before any SOF
+                        return None
+                    seg = fh.read(2)
+                    if len(seg) < 2:
+                        return None
+                    seglen = int.from_bytes(seg, "big")
+                    if seglen < 2:
+                        return None
+                    # SOF0..SOF15 carry the frame size; C4/C8/CC are
+                    # DHT/JPG/DAC, not frame headers.
+                    if 0xC0 <= m <= 0xCF and m not in (0xC4, 0xC8, 0xCC):
+                        sof = fh.read(5)
+                        if len(sof) < 5:
+                            return None
+                        h = int.from_bytes(sof[1:3], "big")
+                        w = int.from_bytes(sof[3:5], "big")
+                        return (h, w, 3) if h > 0 and w > 0 else None
+                    fh.seek(seglen - 2, 1)
+    except OSError:
+        return None
+    return None
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="Score WaterNet weights on UIEB")
     p.add_argument("--weights", type=str, required=True, help="Checkpoint (.npz native or reference .pt)")
@@ -123,34 +192,50 @@ def score_no_reference(args):
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
     )
 
-    # Pass 1: group file PATHS by shape (decode-and-discard keeps host
-    # memory bounded at one batch — raw-890 at native resolution would be
-    # gigabytes if held at once). Insertion-ordered, so output order is
-    # deterministic; with --nr-resize everything lands in one group.
+    # Pass 1: group file PATHS by shape so each distinct shape compiles one
+    # executable (host memory stays bounded at one decoded batch — raw-890
+    # at native resolution would be gigabytes if held at once). Shapes come
+    # from _image_shape's header-only read, NOT a full decode: the old
+    # cv2.imread here decoded every pixel twice per run. Insertion-ordered,
+    # so output order is deterministic; with --nr-resize everything lands
+    # in one group and no file is opened at all in this pass.
     groups: dict = {}
     for f in files:
-        bgr = cv2.imread(str(f))
-        if bgr is None:
-            print(f"Skipping unreadable image: {f}", file=sys.stderr)
-            continue
-        shape = (
-            (args.height, args.width, 3) if args.nr_resize else bgr.shape
-        )
+        if args.nr_resize:
+            shape = (args.height, args.width, 3)
+        else:
+            shape = _image_shape(f)
+            if shape is None:  # unknown container/corrupt header: decode
+                bgr = cv2.imread(str(f))
+                if bgr is None:
+                    print(f"Skipping unreadable image: {f}", file=sys.stderr)
+                    continue
+                shape = bgr.shape
         groups.setdefault(shape, []).append(f)
 
     sums = {"uciqe_raw": 0.0, "uiqm_raw": 0.0, "uciqe_enhanced": 0.0, "uiqm_enhanced": 0.0}
     n_scored = 0
-    for paths in groups.values():
+    # Worklist so header/decoder shape disagreements (cv2.imread applies
+    # EXIF orientation, rotating some JPEGs relative to their SOF header)
+    # can be re-queued under the DECODED shape and scored in a second
+    # sweep; decoded shapes are deterministic, so the re-queue converges.
+    work = list(groups.items())
+    regrouped: dict = {}
+    while work:
+        shape, paths = work.pop(0)
         for start in range(0, len(paths), args.batch_size):
             chunk = paths[start : start + args.batch_size]
             raws = []
             for f in chunk:
                 bgr = cv2.imread(str(f))
-                if bgr is None:  # readable in pass 1, vanished since
+                if bgr is None:  # header parsed but pixels don't decode
                     print(f"Skipping unreadable image: {f}", file=sys.stderr)
                     continue
                 if args.nr_resize:
                     bgr = cv2.resize(bgr, (args.width, args.height))
+                elif bgr.shape != shape:
+                    regrouped.setdefault(bgr.shape, []).append(f)
+                    continue
                 raws.append(cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB))
             if not raws:
                 continue
@@ -172,6 +257,8 @@ def score_no_reference(args):
             ):
                 sums[key] += float(np.asarray(batch)[:n_real].sum())
             n_scored += n_real
+        if not work and regrouped:
+            work, regrouped = list(regrouped.items()), {}
     if n_scored == 0:
         raise FileNotFoundError(f"no readable images in {args.raw_dir}")
     return {k: v / n_scored for k, v in sums.items()} | {"images": n_scored}

@@ -1,0 +1,89 @@
+"""Unit tests for tools/ab_bench.py's subprocess plumbing (no accelerator:
+bench.py is stubbed with a script that prints canned JSON lines)."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+
+@pytest.fixture()
+def ab(monkeypatch):
+    import ab_bench
+
+    return ab_bench
+
+
+def _stub_bench(tmp_path, body):
+    (tmp_path / "bench.py").write_text(body)
+    return tmp_path
+
+
+def test_run_bench_two_line_attaches_hostfed(ab, monkeypatch, tmp_path):
+    """Two-line bench output: the LAST (device-cache contract) line is the
+    primary result; the preceding `_hostfed` line rides along under
+    "hostfed_line" so A/B reports keep both measurement paths."""
+    _stub_bench(
+        tmp_path,
+        "import json\n"
+        "print(json.dumps({'metric': 'uieb_train_images_per_sec_per_chip"
+        "_hostfed', 'value': 300.0}))\n"
+        "print(json.dumps({'metric': 'uieb_train_images_per_sec_per_chip',"
+        " 'value': 600.0, 'device_cache': True}))\n",
+    )
+    monkeypatch.setattr(ab, "REPO", tmp_path)
+    line = ab.run_bench({}, timeout=60)
+    assert line["value"] == 600.0 and line["device_cache"] is True
+    assert line["hostfed_line"]["value"] == 300.0
+    assert "wall_sec" in line
+
+
+def test_run_bench_single_hostfed_line(ab, monkeypatch, tmp_path):
+    """With WATERNET_BENCH_DEVICE_CACHE=0 (the transform-variant mode) the
+    host-fed line is last and becomes the primary result unchanged."""
+    _stub_bench(
+        tmp_path,
+        "import json, os\n"
+        "assert os.environ['WATERNET_BENCH_DEVICE_CACHE'] == '0'\n"
+        "print(json.dumps({'metric': 'uieb_train_images_per_sec_per_chip"
+        "_hostfed', 'value': 300.0}))\n",
+    )
+    monkeypatch.setattr(ab, "REPO", tmp_path)
+    line = ab.run_bench({"WATERNET_BENCH_DEVICE_CACHE": "0"}, timeout=60)
+    assert line["metric"].endswith("_hostfed") and line["value"] == 300.0
+    assert "hostfed_line" not in line
+
+
+def test_transform_variants_disable_device_cache(ab):
+    """Every classical-transform strategy variant must run hostfed-only:
+    its knob doesn't act on the precached steady state, so a device-cache
+    measurement would A/B nothing (round-5 review finding)."""
+    by_name = dict(ab.TRAIN_VARIANTS)
+    for name in (
+        "clahe_interp_gather", "clahe_interp_matmul", "clahe_hist_scatter",
+        "clahe_hist_matmul", "pallas_hist",
+    ):
+        assert by_name[name].get("WATERNET_BENCH_DEVICE_CACHE") == "0", name
+    for name in ("default_bf16", "fp32"):
+        assert "WATERNET_BENCH_DEVICE_CACHE" not in by_name[name], name
+
+
+def test_backstop_mirrors_bench_default(ab):
+    """ab_bench's kill backstop must assume the same WATERNET_BENCH_TIMEOUT
+    default as bench.py itself, or a future drift could SIGKILL a
+    legitimately-running benchmark mid-tunnel."""
+    import inspect
+
+    import bench
+
+    assert "_env_int(\"WATERNET_BENCH_TIMEOUT\", 900)" in inspect.getsource(
+        ab.run_bench
+    )
+    assert '_env_int("WATERNET_BENCH_TIMEOUT", 900)' in inspect.getsource(
+        bench.main
+    )

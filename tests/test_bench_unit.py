@@ -272,3 +272,118 @@ def test_relay_busy_parses_stack_connections(bench, monkeypatch, tmp_path):
         + "   2: 0100007F:C8FE 0100007F:1F90 01 ...\n"  # client -> 8080
     )
     assert bench._relay_busy(8082) is False
+
+
+def test_headline_precached_outranks_hostfed_same_round(bench, monkeypatch, tmp_path):
+    """Within a round the `_precached` stage (the contract path since round
+    4, bench.py:headline_stage_candidates) must outrank the host-fed stage,
+    and the attributed prior result must say which path it came from
+    (device_cache / precache_histeq keys survive the keep-list)."""
+    stages = {
+        "train_bf16_r5": {
+            "ok": True, "value": 334.0, "device_kind": "TPU v5 lite",
+        },
+        "train_bf16_r5_precached": {
+            "ok": True, "value": 640.0, "device_kind": "TPU v5 lite",
+            "device_cache": True, "precache_histeq": True,
+        },
+        "train_bf16": {
+            "ok": True, "value": 300.0, "device_kind": "TPU v5 lite",
+        },
+    }
+    names = [n for n, _ in bench.headline_stage_candidates(stages)]
+    assert names == ["train_bf16_r5_precached", "train_bf16_r5", "train_bf16"]
+
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "tpu_session.json").write_text(
+        json.dumps({"started_utc": "2026-07-29T13:49:46Z", "stages": stages})
+    )
+    got = bench._last_measured_headline()
+    assert got["value"] == 640.0
+    assert got["device_cache"] is True
+    assert got["precache_histeq"] is True
+
+    # An older-round precached stage must NOT outrank a newer round's
+    # host-fed stage: the round tag dominates the path tag.
+    stages["train_bf16_r6"] = {
+        "ok": True, "value": 100.0, "device_kind": "TPU v5 lite",
+    }
+    names = [n for n, _ in bench.headline_stage_candidates(stages)]
+    assert names[0] == "train_bf16_r6"
+
+
+def test_bench_two_line_output_cpu():
+    """End-to-end: `python bench.py` prints the host-fed apples-to-apples
+    line first (metric suffix `_hostfed`) and the `--device-cache` contract
+    line LAST, per the module docstring's output contract."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_TPU_GEN", None)  # non-tunnel host: no relay gate
+    env.pop("XLA_FLAGS", None)  # single CPU device is enough
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "WATERNET_BENCH_HW": "32",
+            "WATERNET_BENCH_BATCH": "2",
+            "WATERNET_BENCH_STEPS": "1",
+            "WATERNET_BENCH_WARMUP": "0",
+            "WATERNET_BENCH_TIMEOUT": "550",
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [
+        json.loads(ln)
+        for ln in proc.stdout.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    assert len(lines) == 2
+    assert lines[0]["metric"] == "uieb_train_images_per_sec_per_chip_hostfed"
+    assert "device_cache" not in lines[0]
+    last = lines[-1]
+    assert last["metric"] == "uieb_train_images_per_sec_per_chip"
+    assert last["device_cache"] is True
+    assert last["value"] > 0
+    assert "cache_build_sec" in last
+
+    # WATERNET_BENCH_DEVICE_CACHE=0 (tools/ab_bench.py's transform-variant
+    # mode): only the host-fed line prints, and it is last.
+    env["WATERNET_BENCH_DEVICE_CACHE"] = "0"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [
+        json.loads(ln)
+        for ln in proc.stdout.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    assert len(lines) == 1
+    assert lines[0]["metric"] == "uieb_train_images_per_sec_per_chip_hostfed"
+
+    # Disabling both lines is a refusal, not a silent no-op run.
+    env["WATERNET_BENCH_HOSTFED"] = "0"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        timeout=120,
+    )
+    assert proc.returncode != 0

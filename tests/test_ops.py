@@ -369,6 +369,13 @@ def test_srgb_poly_transfer_matches_float_formula(monkeypatch):
     flt = np.asarray(color._linear_to_srgb(x))
     in_gamut = (x >= color._SRGB_CUT) & (x <= 1.0)
     assert np.abs(255.0 * (poly[in_gamut] - flt[in_gamut])).max() < 1e-3
+    # POSITIVE CONTROL: the env switch must actually select two different
+    # implementations — were WATERNET_SRGB_TRANSFER dead (both calls
+    # hitting one code path), every closeness assertion here would pass
+    # vacuously. The two paths round differently in float32 on the power
+    # branch (measured 2026-07-29: ~55% of curve-branch points differ in
+    # the last ulp), so bit-identity means the switch is broken.
+    assert not np.array_equal(poly[in_gamut], flt[in_gamut])
     linear_branch = x <= color._SRGB_CUT
     np.testing.assert_array_equal(poly[linear_branch], flt[linear_branch])
     over = x > 1.0
@@ -393,6 +400,8 @@ def test_lab_inverse_poly_vs_float_levels(rng, monkeypatch):
     rare ±1-level boundary flips (exhaustive bound: 4.5e-6 of the cube)."""
     from waternet_tpu.ops.color import lab_u8_to_rgb
 
+    from waternet_tpu.ops import color
+
     lab = rng.integers(0, 256, (128, 128, 3)).astype(np.float32)
     monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "poly")
     poly = np.asarray(lab_u8_to_rgb(lab))
@@ -401,6 +410,16 @@ def test_lab_inverse_poly_vs_float_levels(rng, monkeypatch):
     diff = np.abs(poly - flt)
     assert diff.max() <= 1.0, diff.max()
     assert (diff > 0).mean() < 1e-4
+    # POSITIVE CONTROL (see test_srgb_poly_transfer_matches_float_formula):
+    # on this random sample zero ±1 flips is the likely outcome, so the
+    # u8 agreement above cannot by itself prove the switch dispatches. The
+    # pre-rounding transfer must differ bitwise between the two modes.
+    probe = np.linspace(color._SRGB_CUT, 1.0, 4097, dtype=np.float32)
+    monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "poly")
+    p = np.asarray(color._linear_to_srgb(probe))
+    monkeypatch.setenv("WATERNET_SRGB_TRANSFER", "float")
+    f = np.asarray(color._linear_to_srgb(probe))
+    assert not np.array_equal(p, f)
 
 
 # ---------------------------------------------------------------------------

@@ -98,3 +98,101 @@ def test_score_nr_native_resolution_mixed_shapes(weights_file, tmp_path, rng):
     metrics = json.loads(out.read_text())
     assert metrics["images"] == 5
     assert all(np.isfinite(v) for v in metrics.values())
+
+
+def test_image_shape_header_parsers(tmp_path, rng):
+    """score._image_shape reads (h, w, 3) from the container header alone
+    for every suffix score_no_reference globs, matching cv2.imread's
+    decoded shape; unknown/corrupt headers return None so the caller falls
+    back to a full decode."""
+    import cv2
+
+    import score as cli
+
+    img = None
+    for i, (h, w) in enumerate([(40, 52), (1080, 1920), (7, 3)]):
+        img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        for suffix in (".png", ".jpg", ".bmp"):
+            f = tmp_path / f"{i}{suffix}"
+            assert cv2.imwrite(str(f), img)
+            assert cli._image_shape(f) == cv2.imread(str(f)).shape, f
+
+    # Progressive JPEG uses SOF2 (and APPn/DQT segments before it): the
+    # marker walk must skip to it rather than expect SOF0 first.
+    f = tmp_path / "prog.jpg"
+    assert cv2.imwrite(str(f), img, [cv2.IMWRITE_JPEG_PROGRESSIVE, 1])
+    assert cli._image_shape(f) == cv2.imread(str(f)).shape
+
+    bad = tmp_path / "bad.png"
+    bad.write_bytes(b"junk")
+    assert cli._image_shape(bad) is None
+    trunc = tmp_path / "trunc.jpg"
+    trunc.write_bytes(b"\xff\xd8\xff\xe0\x00\x10")
+    assert cli._image_shape(trunc) is None
+    assert cli._image_shape(tmp_path / "missing.png") is None
+
+
+def test_nr_native_single_decode(weights_file, tmp_path, rng, monkeypatch):
+    """Native-resolution NR scoring decodes each image exactly ONCE: pass 1
+    groups by header-parsed shape (the previous implementation cv2.imread'd
+    every file in both passes — advisor finding, round 3)."""
+    import cv2
+
+    import score as cli
+
+    raw = tmp_path / "d"
+    raw.mkdir()
+    for i, (h, w) in enumerate([(40, 52), (40, 52), (64, 48)]):
+        cv2.imwrite(
+            str(raw / f"{i}.png"),
+            rng.integers(0, 256, (h, w, 3), dtype=np.uint8),
+        )
+
+    calls = []
+    real_imread = cv2.imread
+
+    def counting_imread(path, *a):
+        calls.append(path)
+        return real_imread(path, *a)
+
+    monkeypatch.setattr(cv2, "imread", counting_imread)
+    out = tmp_path / "m.json"
+    cli.main([
+        "--weights", str(weights_file), "--raw-dir", str(raw),
+        "--batch-size", "2", "--json-out", str(out),
+    ])
+    assert json.loads(out.read_text())["images"] == 3
+    assert len(calls) == 3
+
+
+def test_nr_native_header_decoder_disagreement(weights_file, tmp_path, rng, monkeypatch):
+    """A file whose decoded shape disagrees with its header (cv2 applies
+    EXIF orientation at decode time, transposing some JPEGs) must be
+    re-queued under the decoded shape and still scored exactly once."""
+    import cv2
+
+    import score as cli
+
+    raw = tmp_path / "d"
+    raw.mkdir()
+    for i in range(3):
+        cv2.imwrite(
+            str(raw / f"{i}.png"),
+            rng.integers(0, 256, (40, 52, 3), dtype=np.uint8),
+        )
+
+    real_shape = cli._image_shape
+
+    def lying_shape(path):
+        s = real_shape(path)
+        if getattr(path, "name", "") == "1.png" and s is not None:
+            return (s[1], s[0], 3)  # transposed, like an EXIF rotation
+        return s
+
+    monkeypatch.setattr(cli, "_image_shape", lying_shape)
+    out = tmp_path / "m.json"
+    cli.main([
+        "--weights", str(weights_file), "--raw-dir", str(raw),
+        "--batch-size", "4", "--json-out", str(out),
+    ])
+    assert json.loads(out.read_text())["images"] == 3

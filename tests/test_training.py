@@ -276,12 +276,45 @@ def test_val_cache_not_aliased_across_datasets():
     assert _cache_token(ds_b) > _cache_token(ds_a)
     assert _cache_token(SyntheticPairs(2, hw, hw)) > _cache_token(ds_b)
 
-    # A deepcopy must be a NEW identity (the weak-key map doesn't travel
+    # A deepcopy must be a NEW identity (the identity map doesn't travel
     # with the object): a copied-then-mutated dataset can't serve the
     # original's cache.
     import copy
 
     assert _cache_token(copy.deepcopy(ds_a)) != _cache_token(ds_a)
+
+    # Unhashable and value-equal datasets: tokens are identity-keyed, so an
+    # unhashable dataset is accepted, and two value-equal objects do NOT
+    # alias each other's cache entries.
+    class UnhashablePairs:
+        __hash__ = None
+
+        def __eq__(self, other):
+            return isinstance(other, UnhashablePairs)
+
+    u1, u2 = UnhashablePairs(), UnhashablePairs()
+    assert u1 == u2
+    assert _cache_token(u1) == _cache_token(u1)
+    assert _cache_token(u1) != _cache_token(u2)
+
+    # Non-weakrefable objects (no __weakref__ slot) fall back to a fresh
+    # token per call: never cached, never stale.
+    lst = [1, 2, 3]
+    assert _cache_token(lst) != _cache_token(lst)
+
+    # The identity map must not leak: a dead object's entry is dropped at
+    # finalization, so a recycled id() can never resurrect its token.
+    from waternet_tpu.training.trainer import _CACHE_TOKENS
+
+    victim = SyntheticPairs(2, hw, hw)
+    vid = id(victim)
+    _cache_token(victim)
+    assert vid in _CACHE_TOKENS
+    del victim
+    import gc
+
+    gc.collect()
+    assert vid not in _CACHE_TOKENS
 
 
 def test_precache_histeq_matches_in_step_transform():

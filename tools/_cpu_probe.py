@@ -1,0 +1,25 @@
+"""Ad-hoc CPU-only probe runner: replicate tests/conftest.py's axon-plugin
+deregistration so scratch scripts never dial the TPU tunnel. Usage:
+``python tools/_cpu_probe.py script.py`` or pipe code via stdin."""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+use_file = len(sys.argv) > 1 and sys.argv[1] != "-"
+src = open(sys.argv[1]).read() if use_file else sys.stdin.read()
+exec(compile(src, sys.argv[1] if use_file else "<stdin>", "exec"))

@@ -62,33 +62,36 @@ TRAIN_METRICS_NAMES = ["mse", "ssim", "psnr", "perceptual_loss", "loss"]
 VAL_METRICS_NAMES = ["mse", "ssim", "psnr", "perceptual_loss"]
 
 _CACHE_TOKEN_COUNTER = itertools.count()
-_CACHE_TOKENS: "weakref.WeakKeyDictionary" = None  # built on first use
+_CACHE_TOKENS: dict = {}  # id(obj) -> token; entry dropped when obj dies
 
 
 def _cache_token(obj) -> int:
-    """Monotonic identity token for memo keys, tracked in a weak-key map.
+    """Monotonic *identity* token for memo keys.
 
-    ``id()`` is unusable as a cache key: CPython reuses addresses after GC,
-    so a freed object replaced by a new one at the same address would
-    silently alias its cache entry. Tokens from this counter are never
-    reused, and the weak-key map (rather than stamping an attribute on the
-    object) means a ``deepcopy``/unpickle of a tokened dataset is a NEW
-    key — a copied-then-mutated dataset cannot serve the original's cache.
-    Non-weakrefable objects get a fresh token per call — always-rebuild,
-    which is slow but never stale.
+    Bare ``id()`` is unusable as a cache key: CPython reuses addresses
+    after GC, so a freed object replaced by a new one at the same address
+    would silently alias its cache entry. So the map is keyed by ``id`` but
+    a ``weakref.finalize`` removes the entry when the object is
+    deallocated — before its address can be reused — and tokens from the
+    counter are never reused. Keying by identity (not a WeakKeyDictionary,
+    which hashes via the object's own ``__hash__``/``__eq__``) means an
+    unhashable dataset is accepted, and a value-equal ``deepcopy``/unpickle
+    of a tokened dataset is a NEW key — a copied-then-mutated dataset
+    cannot serve the original's cache. Non-weakrefable objects get a fresh
+    token per call — always-rebuild, which is slow but never stale.
     """
-    global _CACHE_TOKENS
-    if _CACHE_TOKENS is None:
-        import weakref
+    import weakref
 
-        _CACHE_TOKENS = weakref.WeakKeyDictionary()
-    tok = _CACHE_TOKENS.get(obj)
-    if tok is None:
-        tok = next(_CACHE_TOKEN_COUNTER)
-        try:
-            _CACHE_TOKENS[obj] = tok
-        except TypeError:
-            pass
+    key = id(obj)
+    tok = _CACHE_TOKENS.get(key)
+    if tok is not None:
+        return tok
+    tok = next(_CACHE_TOKEN_COUNTER)
+    try:
+        weakref.finalize(obj, _CACHE_TOKENS.pop, key, None)
+    except TypeError:
+        return tok  # non-weakrefable: never cached, never stale
+    _CACHE_TOKENS[key] = tok
     return tok
 
 
