@@ -49,16 +49,40 @@ if mode == "dpsp":
         spatial_shards=2,
     )
     engine = TrainingEngine(cfg, mesh=make_mesh(n_data=2, n_spatial=2))
+elif mode == "cached":
+    # augment=True so the in-step dihedral-variant CLAHE lookup (the
+    # precache path's augmentation machinery) crosses the mesh too.
+    cfg = TrainConfig(
+        batch_size=4, im_height=32, im_width=32,
+        precision="fp32", perceptual_weight=0.0, augment=True,
+    )
+    engine = TrainingEngine(cfg)
 else:
     cfg = TrainConfig(
         batch_size=4, im_height=32, im_width=32,
         precision="fp32", perceptual_weight=0.0, augment=False,
     )
     engine = TrainingEngine(cfg)
-rng = np.random.default_rng(0)
-raw = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
-ref = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
-metrics = engine.train_epoch([(raw, ref)], epoch=0)
+if mode == "cached":
+    # Device-cache path under a real 2-process mesh: cache_dataset pins the
+    # dataset + precomputed transforms via _replicate_global's
+    # make_array_from_callback branch (single-process uses device_put), and
+    # n=6/batch=4 leaves a 2-real tail batch padded to the 4-device data
+    # axis inside _cached_index_batches — the same path `--device-cache`
+    # runs in production multi-host training.
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    ds = SyntheticPairs(6, 32, 32, seed=0)
+    engine.cache_dataset(ds, np.arange(6))
+    assert engine._cache_he is not None, "precache_histeq did not engage"
+    metrics = engine.train_epoch_cached(epoch=0)
+    eval_m = engine.eval_epoch_cached()
+    metrics = {"loss": metrics["loss"] + eval_m["mse"]}
+else:
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    ref = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    metrics = engine.train_epoch([(raw, ref)], epoch=0)
 print(
     f"RESULT proc={proc_id} procs={jax.process_count()} "
     f"devices={jax.device_count()} loss={metrics['loss']:.6f}",

@@ -32,11 +32,15 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("mode", ["dp", "dpsp"])
+@pytest.mark.parametrize("mode", ["dp", "dpsp", "cached"])
 def test_two_process_training_agrees(mode):
     """dp: pure data-parallel gradient all-reduce across processes.
     dpsp: 2x2 (data x spatial) mesh with the perceptual term ON — the VGG
-    branch's H-gather collective crosses the process boundary too."""
+    branch's H-gather collective crosses the process boundary too.
+    cached: the production --device-cache path (cache_dataset +
+    train_epoch_cached with precached transforms + eval_epoch_cached) —
+    covers _replicate_global's make_array_from_callback branch and the
+    padded remainder batch of _cached_index_batches across processes."""
     worker = Path(__file__).parent / "multihost_worker.py"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     port = str(_free_port())

@@ -165,11 +165,17 @@ def test_transform_batch_matmul_modes_match_default(rng, monkeypatch):
     got = [np.asarray(t) for t in jax.jit(transform_batch)(batch)]
     for b, g, name in zip(base, got, ("wb", "gc", "he")):
         np.testing.assert_array_equal(b, g, err_msg=name)
+
+
+def test_wb_device_fuzz_degenerate():
     """The histogram-CDF order statistics must track the host float64
     quantiles across random and degenerate inputs (all-black channel,
     constant channel, tiny images). Own RNG: the shared fixture's stream
     position depends on test order, and the f32-vs-f64 boundary-pixel
-    fraction asserted below is data-dependent."""
+    fraction asserted below is data-dependent.
+
+    (Restored round 5: an earlier edit dropped this def line, leaving the
+    body to run inside the preceding matmul-modes test.)"""
     rng = np.random.default_rng(20260729)
     cases = [rng.integers(0, 256, (31, 47, 3), dtype=np.uint8) for _ in range(3)]
     blk = rng.integers(0, 256, (16, 16, 3), dtype=np.uint8)
@@ -478,3 +484,62 @@ def test_degenerate_frames_no_nan(frame):
     for arr in transform(frame):
         a = np.asarray(arr)
         assert np.isfinite(a).all(), "NaN/inf leaked from device transform"
+
+
+def test_clahe_matmul_cap_env_sweep_bitexact(rng, monkeypatch):
+    """WATERNET_CLAHE_MATMUL_CAP_MB re-sizes the one-hot chunking /cell
+    grouping at trace time; any cap must produce bit-identical CLAHE (only
+    scan length and peak memory may move). Sweeps a cap small enough to
+    force multi-chunk histograms and multi-group interp rows at test size,
+    plus one larger than any operand (single-shot paths)."""
+    import importlib
+
+    import cv2
+
+    clahe_mod = importlib.import_module("waternet_tpu.ops.clahe")
+
+    monkeypatch.setenv("WATERNET_CLAHE_HIST", "matmul")
+    monkeypatch.setenv("WATERNET_CLAHE_INTERP", "matmul")
+    # 136x240 at (8, 8): th=17 (odd -> degraded single-row cells), tw=30 —
+    # the same odd-by-even tile class as 1080p's (135, 240) tiles.
+    lum = rng.integers(0, 256, size=(136, 240), dtype=np.uint8)
+    want = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8)).apply(lum)
+    for cap_mb in ("1", "4", "1024"):
+        monkeypatch.setenv("WATERNET_CLAHE_MATMUL_CAP_MB", cap_mb)
+        assert clahe_mod._matmul_cap_bytes() == int(cap_mb) * 1024 * 1024
+        got = np.asarray(clahe_mod.clahe(lum.astype(np.float32)))
+        np.testing.assert_array_equal(
+            got, want.astype(np.float32), err_msg=f"cap {cap_mb} MB"
+        )
+    monkeypatch.setenv("WATERNET_CLAHE_MATMUL_CAP_MB", "zero")
+    with pytest.raises(ValueError, match="WATERNET_CLAHE_MATMUL_CAP_MB"):
+        clahe_mod._matmul_cap_bytes()
+    monkeypatch.setenv("WATERNET_CLAHE_MATMUL_CAP_MB", "-3")
+    with pytest.raises(ValueError, match="WATERNET_CLAHE_MATMUL_CAP_MB"):
+        clahe_mod._matmul_cap_bytes()
+
+
+def test_clahe_onehot_dtype_modes_bitexact(rng, monkeypatch):
+    """The histogram one-hot operand dtype (WATERNET_CLAHE_ONEHOT: int8
+    default, bf16/f32 for A/B) must not change a single count — products
+    are 0/1 and tile areas < 2^24, exact in all three accumulators. Covers
+    both the single-shot and the scan-chunked path (1 MB cap)."""
+    import importlib
+
+    import cv2
+
+    clahe_mod = importlib.import_module("waternet_tpu.ops.clahe")
+    monkeypatch.setenv("WATERNET_CLAHE_HIST", "matmul")
+    lum = rng.integers(0, 256, size=(136, 240), dtype=np.uint8)
+    want = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8)).apply(lum)
+    for dtype in ("int8", "bf16", "f32"):
+        for cap in ("1", "1024"):
+            monkeypatch.setenv("WATERNET_CLAHE_ONEHOT", dtype)
+            monkeypatch.setenv("WATERNET_CLAHE_MATMUL_CAP_MB", cap)
+            got = np.asarray(clahe_mod.clahe(lum.astype(np.float32)))
+            np.testing.assert_array_equal(
+                got, want.astype(np.float32), err_msg=f"{dtype} cap {cap}"
+            )
+    monkeypatch.setenv("WATERNET_CLAHE_ONEHOT", "fp16")
+    with pytest.raises(ValueError, match="WATERNET_CLAHE_ONEHOT"):
+        clahe_mod._onehot_dtypes()

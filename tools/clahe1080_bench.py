@@ -1,0 +1,198 @@
+"""Per-op CLAHE cost breakdown at full-res video shapes (CPU rehearsal).
+
+VERDICT round-4 task 3: at 112x112 the classical transforms were ~47% of
+the fused train step; at 1080p they dominate inference
+(`/root/reference/inference.py:261-323` runs them per frame). This tool
+pre-tunes the 1080p strategy choice so the hardware A/B
+(`tools/ab_bench.py`, `tools/tpu_session.py`) confirms rather than
+explores:
+
+* stage isolation: RGB->LAB, per-tile histogram (scatter / matmul), CLAHE
+  core per interp mode (gather / matmul), LAB->RGB — each AOT-compiled and
+  min-of-N timed on the CPU backend;
+* XLA cost-model FLOPs + bytes per variant (hardware-independent), with a
+  TPU roofline projection ``max(flops/peak_flops, bytes/peak_bw)`` so the
+  strategy ranking reflects the MXU/HBM balance, not CPU quirks — CPU wall
+  times rank gather far ahead because CPU gathers are cheap and CPU
+  matmuls ride no MXU; the roofline is the number that transfers;
+* chunk-cap sweep (``WATERNET_CLAHE_MATMUL_CAP_MB``) for the matmul paths.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/clahe1080_bench.py \
+        [--hw 1080x1920] [--reps 5] [--out docs/clahe_1080.json]
+
+Writes one JSON report and prints a markdown summary table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# TPU v5e roofline constants (public spec sheet): dense bf16 peak and HBM
+# bandwidth. Override for other targets.
+PEAK_FLOPS = float(os.environ.get("WATERNET_TPU_PEAK_TFLOPS", "197")) * 1e12
+PEAK_BW = float(os.environ.get("WATERNET_TPU_HBM_GBPS", "819")) * 1e9
+
+
+def _cost(compiled):
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return {
+            "gflops": round(float(ca.get("flops", 0.0)) / 1e9, 4),
+            "mbytes": round(float(ca.get("bytes accessed", 0.0)) / 1e6, 3),
+        }
+    except Exception:
+        return {"gflops": None, "mbytes": None}
+
+
+def _roofline_us(cost):
+    if not cost or cost["gflops"] is None:
+        return None
+    return round(
+        max(cost["gflops"] * 1e9 / PEAK_FLOPS, cost["mbytes"] * 1e6 / PEAK_BW)
+        * 1e6,
+        2,
+    )
+
+
+def measure(fn, *args, reps=5):
+    """AOT compile once; min-of-reps steady wall + cost model."""
+    import jax
+
+    t0 = time.perf_counter()
+    compiled = jax.jit(fn).lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    jax.block_until_ready(compiled(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*args))
+        best = min(best, time.perf_counter() - t0)
+    cost = _cost(compiled)
+    return {
+        "wall_ms": round(best * 1e3, 3),
+        "compile_s": round(compile_s, 2),
+        **cost,
+        "roofline_us": _roofline_us(cost),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--hw", default="1080x1920")
+    p.add_argument("--reps", type=int, default=5)
+    p.add_argument("--caps-mb", default="8,32,64,256")
+    p.add_argument("--out", default=str(REPO / "docs" / "clahe_1080.json"))
+    args = p.parse_args()
+    h, w = (int(x) for x in args.hw.split("x"))
+
+    from waternet_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    import importlib
+
+    import jax
+    import numpy as np
+
+    # waternet_tpu.ops re-exports the clahe FUNCTION; we need the module.
+    cl = importlib.import_module("waternet_tpu.ops.clahe")
+    from waternet_tpu.ops.color import lab_u8_to_rgb, rgb_to_lab_u8
+
+    rng = np.random.default_rng(0)
+    rgb = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+    lum = rng.integers(0, 256, (h, w)).astype(np.float32)
+    dev = jax.devices()[0]
+    report = {
+        "hw": [h, w],
+        "backend": getattr(dev, "device_kind", str(dev)),
+        "roofline": {"peak_tflops": PEAK_FLOPS / 1e12, "hbm_gbps": PEAK_BW / 1e9},
+        "stages": {},
+        "histeq": {},
+        "cap_sweep_mb": {},
+    }
+
+    # --- stage isolation ---
+    report["stages"]["rgb_to_lab"] = measure(rgb_to_lab_u8, rgb, reps=args.reps)
+    ty, tx = cl.TILE_GRID
+    hp = h + (0 if h % ty == 0 else ty - h % ty)
+    wp = w + (0 if w % tx == 0 else tx - w % tx)
+    th, tw = hp // ty, wp // tx
+    tiles = (
+        np.pad(lum, ((0, hp - h), (0, wp - w)), mode="reflect")
+        .astype(np.int32)
+        .reshape(ty, th, tx, tw)
+        .transpose(0, 2, 1, 3)
+        .reshape(ty * tx, th * tw)
+    )
+    os.environ["WATERNET_CLAHE_HIST"] = "scatter"
+    report["stages"]["hist_scatter"] = measure(
+        lambda t: cl._tile_hist(t, None), tiles, reps=args.reps
+    )
+    # One-hot operand dtype A/B (int8 is the landed default: half the
+    # dominant byte stream of the bf16 one-hot, exact counts either way);
+    # the int8 row doubles as the plain hist_matmul stage measurement.
+    for dt in ("int8", "bf16"):
+        os.environ["WATERNET_CLAHE_HIST"] = "matmul"
+        os.environ["WATERNET_CLAHE_ONEHOT"] = dt
+        report["stages"][f"hist_matmul_onehot_{dt}"] = measure(
+            lambda t: cl._tile_hist(t, None), tiles, reps=args.reps
+        )
+    os.environ.pop("WATERNET_CLAHE_ONEHOT", None)
+    for mode in ("gather", "matmul"):
+        os.environ["WATERNET_CLAHE_HIST"] = "scatter"
+        os.environ["WATERNET_CLAHE_INTERP"] = mode
+        # NB: fresh lambda per variant — the strategy envs are read at
+        # trace time and jax's tracing cache keys on the function object,
+        # so passing cl.clahe itself would silently reuse the first trace.
+        report["stages"][f"clahe_core_interp_{mode}"] = measure(
+            lambda x: cl.clahe(x), lum, reps=args.reps
+        )
+    lab = np.asarray(rgb_to_lab_u8(rgb))
+    report["stages"]["lab_to_rgb"] = measure(lab_u8_to_rgb, lab, reps=args.reps)
+
+    # --- full histeq per strategy pair ---
+    for hist in ("scatter", "matmul"):
+        for interp in ("gather", "matmul"):
+            os.environ["WATERNET_CLAHE_HIST"] = hist
+            os.environ["WATERNET_CLAHE_INTERP"] = interp
+            report["histeq"][f"{hist}+{interp}"] = measure(
+                lambda x: cl.histeq(x), rgb, reps=args.reps
+            )
+
+    # --- chunk-cap sweep on the all-matmul pair ---
+    os.environ["WATERNET_CLAHE_HIST"] = "matmul"
+    os.environ["WATERNET_CLAHE_INTERP"] = "matmul"
+    for cap in args.caps_mb.split(","):
+        os.environ["WATERNET_CLAHE_MATMUL_CAP_MB"] = cap.strip()
+        report["cap_sweep_mb"][cap.strip()] = measure(
+            lambda x: cl.histeq(x), rgb, reps=args.reps
+        )
+    os.environ.pop("WATERNET_CLAHE_MATMUL_CAP_MB", None)
+
+    Path(args.out).write_text(json.dumps(report, indent=2))
+    print(f"# CLAHE breakdown {h}x{w} on {report['backend']}\n")
+    print("| item | wall ms | GFLOP | MB | v5e roofline µs |")
+    print("|---|---|---|---|---|")
+    for section in ("stages", "histeq", "cap_sweep_mb"):
+        for name, r in report[section].items():
+            label = name if section != "cap_sweep_mb" else f"cap {name} MB"
+            print(
+                f"| {label} | {r['wall_ms']} | {r['gflops']} | "
+                f"{r['mbytes']} | {r['roofline_us']} |"
+            )
+    print(f"\nreport -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,9 +14,10 @@ pipeline given the same L input:
 1. Pad right/bottom with reflect-101 so H, W divide the tile grid.
 2. Per-tile 256-bin histograms, three strategies (``WATERNET_CLAHE_HIST`` /
    ``use_pallas``): XLA scatter-add (CPU default; no intermediate),
-   one-hot MXU matmul (TPU default; lax.scan-chunked so the bf16 one-hot
-   stays under a 64 MB cap at any frame size), or the Pallas VPU
-   comparison-reduction kernel.
+   one-hot MXU matmul (TPU default; int8 operands by default — see
+   ``_onehot_dtypes`` — lax.scan-chunked so the one-hot stays under a
+   64 MB cap at any frame size), or the Pallas VPU comparison-reduction
+   kernel.
 3. Integer clip limit ``max(int(clipLimit * tileArea / 256), 1)`` — note with
    the reference's clipLimit=0.1 this is the minimum value 1, i.e. maximal
    clipping: the equalization mostly rank-equalizes the *distinct* gray
@@ -81,6 +82,45 @@ def histeq_np(rgb: np.ndarray) -> np.ndarray:
 _MATMUL_ONEHOT_CAP_BYTES = 64 * 1024 * 1024
 
 
+def _matmul_cap_bytes() -> int:
+    """The one-hot operand cap, trace-time tunable for chunk-sizing A/Bs
+    (``WATERNET_CLAHE_MATMUL_CAP_MB``, default 64). Exactness is
+    cap-invariant (tests sweep it); only scan length / peak memory move."""
+    mb = os.environ.get("WATERNET_CLAHE_MATMUL_CAP_MB", "").strip()
+    if not mb:
+        return _MATMUL_ONEHOT_CAP_BYTES
+    try:
+        val = int(mb)
+    except ValueError:
+        val = 0
+    if val <= 0:
+        raise ValueError(
+            f"WATERNET_CLAHE_MATMUL_CAP_MB={mb!r}: expected a positive "
+            "integer (megabytes)"
+        )
+    return val * 1024 * 1024
+
+
+def _onehot_dtypes():
+    """(operand dtype, accumulator dtype) for the histogram one-hot matmul.
+
+    ``int8`` (default) halves the dominant one-hot byte stream vs bf16 and
+    uses the MXU's native int8 path with int32 accumulation; every product
+    is 0/1 and tile areas are < 2^24, so counts are exact in any of these.
+    ``WATERNET_CLAHE_ONEHOT`` selects bf16/f32 for hardware A/B.
+    """
+    mode = os.environ.get("WATERNET_CLAHE_ONEHOT", "int8").strip().lower()
+    if mode == "int8":
+        return jnp.int8, jnp.int32
+    if mode == "bf16":
+        return jnp.bfloat16, jnp.float32
+    if mode == "f32":
+        return jnp.float32, jnp.float32
+    raise ValueError(
+        f"WATERNET_CLAHE_ONEHOT={mode!r}: expected 'int8', 'bf16' or 'f32'"
+    )
+
+
 def _interp_mode(th: int, tw: int) -> str:
     """Resolve the LUT-interpolation strategy: 'gather' or 'matmul'.
 
@@ -88,7 +128,8 @@ def _interp_mode(th: int, tw: int) -> str:
     shape when the cell decomposition is impossible — see clahe()). Auto
     picks the one-hot matmul on TPU (gathers serialize on TPU; a one-hot
     bf16 matmul rides the MXU). Memory is bounded either way: the matmul
-    chunks itself under ``_MATMUL_ONEHOT_CAP_BYTES``, and odd tile sizes
+    chunks itself under the env-tunable :func:`_matmul_cap_bytes` cap
+    (default ``_MATMUL_ONEHOT_CAP_BYTES``), and odd tile sizes
     degrade the cells to single rows/columns (more, smaller matmuls) —
     still MXU-shaped, so auto enables them too; `tools/ab_bench.py`
     measures whether that holds up against gather per config.
@@ -140,23 +181,31 @@ def _tile_hist(tiles, use_pallas):
 
         return tile_histogram(tiles)
     if mode == "matmul":
-        # hist[t, b] = ones(A) . onehot[t, :, b] — bf16 batched matmuls on
-        # the MXU with f32 accumulation (exact: 0/1 products, integer sums
-        # < 2^24). Large tiles (1080p: 32k+ px) are chunked with lax.scan
-        # so the materialized one-hot stays bounded regardless of frame
-        # size — the pure-XLA analog of the Pallas kernel's chunking.
+        # hist[t, b] = ones(A) . onehot[t, :, b] — one-hot batched matmuls
+        # on the MXU. Default operand dtype is int8 with int32 accumulation
+        # (exact: 0/1 products, integer sums < 2^24): the one-hot is the
+        # dominant byte stream of the whole CLAHE matmul path (~1 GB/frame
+        # at 1080p in bf16 — tools/clahe1080_bench.py), so int8 halves it
+        # and rides the v5e MXU's native int8 throughput. bf16/f32 kept
+        # under WATERNET_CLAHE_ONEHOT for hardware A/B. Large tiles
+        # (1080p: 32k+ px) are chunked with lax.scan so the materialized
+        # one-hot stays bounded regardless of frame size — the pure-XLA
+        # analog of the Pallas kernel's chunking.
+        dt, acc_dt = _onehot_dtypes()
+        isz = jnp.dtype(dt).itemsize
+        cap = _matmul_cap_bytes()
         chunk = tile_area
-        if n_tiles * tile_area * 256 * 2 > _MATMUL_ONEHOT_CAP_BYTES:
-            chunk = max(_MATMUL_ONEHOT_CAP_BYTES // (n_tiles * 256 * 2), 256)
+        if n_tiles * tile_area * 256 * isz > cap:
+            chunk = max(cap // (n_tiles * 256 * isz), 256)
 
         def _count(vals):  # (T, chunk) int32, -1 marks padding
-            onehot = jax.nn.one_hot(vals, 256, dtype=jnp.bfloat16)
-            ones = jnp.ones((n_tiles, 1, vals.shape[1]), jnp.bfloat16)
+            onehot = jax.nn.one_hot(vals, 256, dtype=dt)
+            ones = jnp.ones((n_tiles, 1, vals.shape[1]), dt)
             counts = jax.lax.dot_general(
                 ones,
                 onehot,
                 (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=acc_dt,
             )  # (T, 1, 256)
             return counts[:, 0, :]
 
@@ -170,7 +219,7 @@ def _tile_hist(tiles, use_pallas):
         def body(acc, v):
             return acc + _count(v), None
 
-        hist, _ = jax.lax.scan(body, jnp.zeros((n_tiles, 256), jnp.float32), vals)
+        hist, _ = jax.lax.scan(body, jnp.zeros((n_tiles, 256), acc_dt), vals)
         return hist.astype(jnp.int32)
     # XLA scatter path: bincount lowers to scatter-add.
     tile_ids = jnp.repeat(jnp.arange(n_tiles, dtype=jnp.int32), tile_area)
@@ -219,10 +268,11 @@ def _fit_cell_rows(cell_h, cells_y, cell_w, wp):
     def row_bytes(ch):
         return max(ncx * ch * cell_w * 256 * 2, tables_row)
 
+    cap = _matmul_cap_bytes()
     d = cell_h
-    while d > 1 and row_bytes(d) > _MATMUL_ONEHOT_CAP_BYTES:
+    while d > 1 and row_bytes(d) > cap:
         d = max(k for k in range(1, d) if d % k == 0)
-    if row_bytes(d) > _MATMUL_ONEHOT_CAP_BYTES:
+    if row_bytes(d) > cap:
         return None
     if d != cell_h:
         lo, hi = cells_y
@@ -244,7 +294,7 @@ def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, cell_h, cell_w):
     values are integers <= 255, exactly representable in bf16), so the
     result is bit-identical to the gather path. Cell rows are processed in
     lax.scan groups sized so the one-hot (and the per-group tables) stay
-    under ``_MATMUL_ONEHOT_CAP_BYTES`` at any frame size.
+    under the :func:`_matmul_cap_bytes` cap at any frame size.
 
     Returns four (hp, wp) float32 planes (quadrants 11, 12, 21, 22).
     """
@@ -257,7 +307,7 @@ def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, cell_h, cell_w):
     # Largest divisor of ncy for which BOTH per-group operands (one-hot and
     # LUT tables) fit the cap.
     per_row = max(ncx * cell_h * cell_w * 256 * 2, ncx * 256 * 4 * 2)
-    budget = max(_MATMUL_ONEHOT_CAP_BYTES // per_row, 1)
+    budget = max(_matmul_cap_bytes() // per_row, 1)
     g = max(d for d in range(1, ncy + 1) if ncy % d == 0 and d <= budget)
     n_groups = ncy // g
 
