@@ -316,17 +316,11 @@ def measure_train(
         )
         idx_d = engine._replicate_global(idx_b)
         n_real = jnp.asarray(n_real_i, jnp.int32)
-        if engine._cache_he is not None:
-            step_fn = engine.train_step_cached_pre
-            step_args = (
-                engine._cache_raw, engine._cache_ref, engine._cache_wb,
-                engine._cache_gc, engine._cache_he, idx_d, rng, n_real,
-            )
-        else:
-            step_fn = engine.train_step_cached
-            step_args = (
-                engine._cache_raw, engine._cache_ref, idx_d, rng, n_real,
-            )
+        # Same dispatch training itself uses (trainer.cached_train_step is
+        # the single source of truth), so this measures the exact program
+        # --device-cache runs — incl. precache_vgg_ref via config_overrides.
+        step_fn, cache_args = engine.cached_train_step()
+        step_args = (*cache_args, idx_d, rng, n_real)
     else:
         step_fn = engine.train_step
         step_args = (raw_d, ref_d, rng, n_real)
@@ -400,6 +394,9 @@ def measure_train(
     if device_cache:
         line["device_cache"] = True
         line["precache_histeq"] = engine._cache_he is not None
+        line["precache_vgg_ref"] = (
+            getattr(engine, "_cache_vgg_ref", None) is not None
+        )
         line["cache_build_sec"] = round(cache_build_s, 2)
     return line
 
@@ -624,6 +621,7 @@ def _last_measured_headline():
                 "value", "unit", "vs_baseline", "step_ms", "preprocess_ms",
                 "model_tflop_per_step", "mfu", "device_kind", "batch", "hw",
                 "precision", "srgb_transfer", "device_cache", "precache_histeq",
+                "precache_vgg_ref",
             )
             out = {k: entry[k] for k in keep if k in entry}
             # Prefer the stage's own timestamp (run_stage stamps one); a

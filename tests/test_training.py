@@ -396,3 +396,87 @@ def test_device_cached_matches_host_fed_under_spatial_sharding():
         assert m_host[k] == pytest.approx(m_cached[k], rel=1e-5), (
             k, m_host[k], m_cached[k],
         )
+
+
+def test_precache_vgg_ref_matches_in_step():
+    """precache_vgg_ref=True (the perceptual ref branch's VGG forward
+    hoisted to cache-build time, gathered per step by [variant, item])
+    must train equivalently to recomputing vgg(ref) in-step: inputs are
+    identical values through the same function, so only compile-boundary
+    reassociation may differ (fp32 here -> tight tolerance). Augmentation
+    ON so the dihedral feature table's variant selection is exercised.
+    Also pins the point of the flag: the step program must LOSE the
+    vgg(ref) forward's FLOPs."""
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    n, bs, hw = 8, 4, 32
+    cfg = dict(
+        batch_size=bs, im_height=hw, im_width=hw, precision="fp32",
+        perceptual_weight=0.05, shuffle=True, augment=True,
+    )
+    ds = SyntheticPairs(n, hw, hw, seed=0)
+    idx = np.arange(n)
+
+    vr = TrainingEngine(TrainConfig(precache_vgg_ref=True, **cfg))
+    vr.cache_dataset(ds, idx)
+    assert vr._cache_vgg_ref is not None
+    assert vr._cache_vgg_ref.shape[:2] == (8, n)  # [variant, item]
+
+    plain = TrainingEngine(TrainConfig(precache_vgg_ref=False, **cfg))
+    plain.cache_dataset(ds, idx)
+    assert plain._cache_vgg_ref is None
+
+    for epoch in range(2):
+        m_vr = vr.train_epoch_cached(epoch=epoch)
+        m_plain = plain.train_epoch_cached(epoch=epoch)
+        for k in m_plain:
+            assert m_vr[k] == pytest.approx(m_plain[k], rel=1e-4, abs=1e-6), (
+                epoch, k, m_vr[k], m_plain[k],
+            )
+    # Parameters stay equivalent after both epochs, not just the metrics.
+    pa = jax.tree_util.tree_leaves(plain.state.params)
+    pb = jax.tree_util.tree_leaves(vr.state.params)
+    for a, b in zip(pa, pb):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+    # FLOP accounting: the vggref step must be cheaper than the plain
+    # precached step by a whole in-context VGG forward. Measured at this
+    # size (fp32/32x32/b4): removing fwd(ref) drops exactly 1/3 of the
+    # in-context VGG share = 7.9% of step FLOPs; a standalone-compiled
+    # vgg.apply counts 4x the in-context forward here (XLA CPU picks a
+    # different conv lowering), so the bound is against the step itself.
+    def flops(engine, step, extra):
+        rng = jax.random.PRNGKey(0)
+        idx_b, n_real = next(engine._cached_index_batches(n, 0, False))
+        args = (
+            engine.state, engine._cache_raw, engine._cache_ref,
+            engine._cache_wb, engine._cache_gc, engine._cache_he,
+            *extra, engine._replicate_global(idx_b), rng,
+            jnp.asarray(n_real, jnp.int32),
+        )
+        ca = step.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca["flops"])
+
+    f_plain = flops(plain, plain.train_step_cached_pre, ())
+    f_vr = flops(vr, vr.train_step_cached_pre_vggref, (vr._cache_vgg_ref,))
+    assert f_vr < 0.95 * f_plain, (f_plain, f_vr)
+
+    # The dispatch helper is the single source of truth bench uses: it must
+    # hand back the vggref step exactly when the table exists.
+    assert vr.cached_train_step()[0] is vr.train_step_cached_pre_vggref
+    assert plain.cached_train_step()[0] is plain.train_step_cached_pre
+
+    # The flag without its dihedral substrate is an error, not a silent
+    # fall-through to the slow path (an A/B run must never measure nothing).
+    bad = TrainingEngine(
+        TrainConfig(precache_vgg_ref=True, precache_histeq=False, **cfg)
+    )
+    with pytest.raises(ValueError, match="precache_vgg_ref"):
+        bad.cache_dataset(ds, idx)

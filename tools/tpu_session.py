@@ -53,6 +53,10 @@ AB_VARIANTS = [
     # on hardware. Standard elementwise lowering — safe to run first.
     ("srgb_float", {"WATERNET_SRGB_TRANSFER": "float"}),
     ("fp32", {"_precision": "fp32"}),
+    # Round-5 matmul-path knobs (safe lowerings): one-hot operand dtype
+    # (int8 default vs bf16) and the chunk cap (docs/CLAHE_1080.md).
+    ("clahe_onehot_bf16", {"WATERNET_CLAHE_ONEHOT": "bf16"}),
+    ("clahe_cap_16mb", {"WATERNET_CLAHE_MATMUL_CAP_MB": "16"}),
     ("clahe_hist_pallas", {"WATERNET_CLAHE_HIST": "pallas"}),
     ("clahe_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
     ("clahe_hist_matmul", {"WATERNET_CLAHE_HIST": "matmul"}),
@@ -231,8 +235,16 @@ def _render_markdown(report) -> str:
         ]
     for key, label in (
         (
-            "train_bf16_r3_precached",
+            "train_bf16_r5_precached",
             "HBM-resident + precached transforms (zero in-step classical ops)",
+        ),
+        (
+            "train_bf16_r5_vggref",
+            "As precached + perceptual ref features gathered (precache_vgg_ref)",
+        ),
+        (
+            "train_bf16_r3_precached",
+            "HBM-resident + precached transforms (round-3 naming, if present)",
         ),
         ("train_bf16_batch32", "Batch-scaling point (batch 32)"),
         ("train_bf16_batch64", "Throughput-optimal batch 64"),
@@ -518,26 +530,40 @@ def main():
 
     # Headline first: if the tunnel dies mid-session this is the number
     # that matters most. The stage name carries a round tag because resume
-    # skips ok stages — round 3 changed the preprocessing code (poly sRGB
-    # transfer), so the optimized step needs a FRESH stage to ever be
-    # measured; the round-2 "train_bf16" entry stays as the before side.
+    # skips ok stages — each round's optimized code needs a FRESH stage to
+    # ever be measured (round 5: int8 one-hot histograms, two-line bench);
+    # the round-2 "train_bf16" entry stays as the before side. The r3
+    # names were never measured (tunnel dead since round 2) and are
+    # superseded by these.
     s.run_stage(
-        "train_bf16_r3",
+        "train_bf16_r5",
         lambda: bench.measure_train(
             batch=args.batch, hw=args.hw, precision="bf16", warmup=3,
             steps=args.train_steps,
         ),
     )
     # The HBM-resident + precached-transforms step (the --device-cache
-    # default): gathers the batch on device and runs ZERO classical
-    # transforms in the step — the round-3 answer to "preprocessing is
-    # ~47% of the step". Measured separately from the host-fed headline
-    # so both remain comparable across rounds.
+    # default, and the bench CONTRACT line since round 4): gathers the
+    # batch on device and runs ZERO classical transforms in the step.
+    # Measured separately from the host-fed headline so both remain
+    # comparable across rounds.
     s.run_stage(
-        "train_bf16_r3_precached",
+        "train_bf16_r5_precached",
         lambda: bench.measure_train(
             batch=args.batch, hw=args.hw, precision="bf16", warmup=3,
             steps=args.train_steps, device_cache=True,
+        ),
+    )
+    # precache_vgg_ref A/B: the perceptual ref branch gathered instead of
+    # recomputed (-8.6% step FLOPs at this shape, docs/MFU.md). Name
+    # deliberately does NOT match the headline regex — it's an A/B of a
+    # default-off flag, not the contract path.
+    s.run_stage(
+        "train_bf16_r5_vggref",
+        lambda: bench.measure_train(
+            batch=args.batch, hw=args.hw, precision="bf16", warmup=3,
+            steps=args.train_steps, device_cache=True,
+            precache_vgg_ref=True,
         ),
     )
 

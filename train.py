@@ -52,6 +52,7 @@ def parse_args(argv=None):
     p.add_argument("--host-preprocess", action="store_true", help="cv2/NumPy WB+GC+CLAHE on host (bit-exact, slow)")
     p.add_argument("--device-cache", action="store_true", help="Pin the whole uint8 dataset in device memory (UIEB@112x112 ~60 MB) and gather batches on device: zero per-step host feed, bit-identical epochs (same Philox shuffle + augment streams)")
     p.add_argument("--no-precache-histeq", action="store_true", help="With --device-cache: keep WB/GC/CLAHE inside the step instead of precomputing them (CLAHE per dihedral augmentation variant) at cache-build time. Precaching is default because it removes ~half the measured step time at a few hundred MB of HBM")
+    p.add_argument("--precache-vgg-ref", action="store_true", help="With --device-cache: also precompute the perceptual term's VGG features of every dihedral ref variant at cache-build time (the ref branch carries no gradient), removing ~8.6%% of step FLOPs (docs/MFU.md). Default off pending hardware A/B; numerics equivalent within compute-dtype tolerance")
     p.add_argument("--no-shuffle", action="store_true", help="Reference bug-compat: no train shuffling")
     p.add_argument("--no-augment", action="store_true", help="Disable flips/rot90 augmentation")
     p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from, or 'auto' to pick up the latest run's state")
@@ -115,6 +116,7 @@ def main(argv=None):
         host_preprocess=args.host_preprocess,
         spatial_shards=args.spatial_shards,
         precache_histeq=not args.no_precache_histeq,
+        precache_vgg_ref=args.precache_vgg_ref,
     )
 
     # --- data ---

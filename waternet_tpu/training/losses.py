@@ -33,10 +33,24 @@ def mse_255(out: jnp.ndarray, ref: jnp.ndarray, mask=None) -> jnp.ndarray:
 
 
 def perceptual_loss(
-    vgg: VGG19Features, vgg_params, out: jnp.ndarray, ref: jnp.ndarray, mask=None
+    vgg: VGG19Features,
+    vgg_params,
+    out: jnp.ndarray,
+    ref: jnp.ndarray,
+    mask=None,
+    ref_feats: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
+    """``ref_feats`` short-circuits the reference branch: the ref image is
+    constant w.r.t. params, so its VGG forward can be precomputed once per
+    cached dataset (TrainConfig.precache_vgg_ref) and gathered per step —
+    a third of the step's VGG FLOPs, 8.6% of the whole step
+    (docs/MFU.md). When given, ``ref`` is ignored."""
     fx = vgg.apply(vgg_params, imagenet_normalize(out))
-    fy = vgg.apply(vgg_params, imagenet_normalize(ref))
+    fy = (
+        ref_feats
+        if ref_feats is not None
+        else vgg.apply(vgg_params, imagenet_normalize(ref))
+    )
     sq = jnp.square(255.0 * (fx - fy))
     return masked_mean(_per_image_mean(sq), mask)
 

@@ -130,6 +130,16 @@ class TrainConfig:
     # HBM cost: (2 + variants) extra uint8 dataset copies (UIEB-800 at
     # 112x112: ~300 MB). Only affects the cached path.
     precache_histeq: bool = True
+    # Additionally precompute the VGG19 relu5_4 features of every dihedral
+    # ref variant at cache-build time; the step's perceptual term then
+    # gathers fy instead of running vgg(ref) — the ref branch is constant
+    # w.r.t. params, so only numerics-at-compile-boundary can differ
+    # (equivalence bounded by test_precache_vgg_ref_matches_in_step).
+    # Removes 1/3 of the step's VGG FLOPs = 8.6% of the step (docs/MFU.md).
+    # HBM cost: variants x N x (H/16 x W/16 x 512) in the compute dtype
+    # (UIEB-800 at 112x112 bf16: ~320 MB). Requires precache_histeq (same
+    # dihedral machinery). Default off pending the hardware A/B.
+    precache_vgg_ref: bool = False
 
     @property
     def dtype(self):
@@ -234,7 +244,7 @@ class TrainingEngine:
             t, NamedSharding(self.mesh, P(batch_axes))
         )
 
-    def _losses_and_out(self, params, x, wbn, hen, gcn, refn, mask):
+    def _losses_and_out(self, params, x, wbn, hen, gcn, refn, mask, ref_feats=None):
         out = self.model.apply(params, x, wbn, hen, gcn)
         mse = mse_255(out, refn, mask)
         if self.config.perceptual_weight == 0.0:
@@ -244,6 +254,11 @@ class TrainingEngine:
             self.vgg, self.vgg_params,
             self._unshard_spatial(out), self._unshard_spatial(refn),
             mask,
+            ref_feats=(
+                self._unshard_spatial(ref_feats)
+                if ref_feats is not None
+                else None
+            ),
         )
         loss = self.config.perceptual_weight * perc + mse
         return loss, (out, {"mse": mse, "perceptual_loss": perc})
@@ -335,16 +350,18 @@ class TrainingEngine:
             raw_u8, ref_u8 = _gather_cached(cache_raw, cache_ref, idx)
             return train_step(state, raw_u8, ref_u8, rng, n_real)
 
-        def train_step_cached_pre(
+        def _cached_pre_body(
             state: TrainStateT, cache_raw, cache_ref, cache_wb, cache_gc,
-            cache_he, idx, rng, n_real,
+            cache_he, cache_vgg_ref, idx, rng, n_real,
         ):
             """Cached step with the transforms hoisted out (precache_histeq):
             gather raw/ref/WB/GC and augment them with SHARED draws (WB and
             gamma commute bit-exactly with every flip/rot90), then select
             each image's CLAHE from the dihedral variant table — the entry
             IS histeq of the augmented image, so the step computes no
-            classical transform at all."""
+            classical transform at all. With ``cache_vgg_ref`` (the
+            precache_vgg_ref table, same [variant, item] indexing) the
+            perceptual term also skips its vgg(ref) forward."""
             mask = _mask(idx.shape[0], n_real)
             raw = jnp.take(cache_raw, idx, axis=0).astype(jnp.float32)
             ref = jnp.take(cache_ref, idx, axis=0).astype(jnp.float32)
@@ -363,6 +380,9 @@ class TrainingEngine:
             else:
                 variant = jnp.zeros(idx.shape[0], jnp.int32)
             he = cache_he[variant, idx].astype(jnp.float32)
+            ref_feats = (
+                cache_vgg_ref[variant, idx] if cache_vgg_ref is not None else None
+            )
             raw, ref, wb, gc, he = (
                 jax.lax.with_sharding_constraint(t, bsh)
                 for t in (raw, ref, wb, gc, he)
@@ -372,9 +392,29 @@ class TrainingEngine:
             )
             new_state, loss, out, aux = _update(
                 state,
-                lambda p: self._losses_and_out(p, x, wbn, hen, gcn, refn, mask),
+                lambda p: self._losses_and_out(
+                    p, x, wbn, hen, gcn, refn, mask, ref_feats=ref_feats
+                ),
             )
             return new_state, self._metrics(out, refn, aux, mask, loss)
+
+        def train_step_cached_pre(
+            state: TrainStateT, cache_raw, cache_ref, cache_wb, cache_gc,
+            cache_he, idx, rng, n_real,
+        ):
+            return _cached_pre_body(
+                state, cache_raw, cache_ref, cache_wb, cache_gc, cache_he,
+                None, idx, rng, n_real,
+            )
+
+        def train_step_cached_pre_vggref(
+            state: TrainStateT, cache_raw, cache_ref, cache_wb, cache_gc,
+            cache_he, cache_vgg_ref, idx, rng, n_real,
+        ):
+            return _cached_pre_body(
+                state, cache_raw, cache_ref, cache_wb, cache_gc, cache_he,
+                cache_vgg_ref, idx, rng, n_real,
+            )
 
         def eval_step_cached(state: TrainStateT, cache_raw, cache_ref, idx, n_real):
             raw_u8, ref_u8 = _gather_cached(cache_raw, cache_ref, idx)
@@ -408,6 +448,12 @@ class TrainingEngine:
         self.train_step_cached_pre = jax.jit(
             train_step_cached_pre,
             in_shardings=(rep,) * 9,
+            out_shardings=(rep, rep),
+            donate_argnums=(0,),
+        )
+        self.train_step_cached_pre_vggref = jax.jit(
+            train_step_cached_pre_vggref,
+            in_shardings=(rep,) * 10,
             out_shardings=(rep, rep),
             donate_argnums=(0,),
         )
@@ -520,10 +566,26 @@ class TrainingEngine:
         are additionally hoisted out of the step into precomputed caches —
         still bit-identical (see TrainConfig.precache_histeq).
         """
+        if self.config.precache_vgg_ref and not (
+            self.config.precache_histeq and not self.config.host_preprocess
+        ):
+            # The vggref table rides the same dihedral-variant machinery
+            # (and step variant) as the CLAHE precache; silently ignoring
+            # the flag would let an A/B run measure nothing.
+            raise ValueError(
+                "precache_vgg_ref requires precache_histeq=True and "
+                "host_preprocess=False"
+            )
         self._cache_raw, self._cache_ref = self._build_cache(dataset, indices)
         self._cache_wb = self._cache_gc = self._cache_he = None
+        self._cache_vgg_ref = None
         if self.config.precache_histeq and not self.config.host_preprocess:
             self._build_transform_cache()
+            if (
+                self.config.precache_vgg_ref
+                and self.config.perceptual_weight != 0.0
+            ):
+                self._build_vgg_ref_cache()
 
     def _build_transform_cache(self) -> None:
         """Precompute device-path WB/GC and the dihedral CLAHE table for the
@@ -577,6 +639,50 @@ class TrainingEngine:
         self._cache_gc = self._replicate_global(gc_np)
         self._cache_he = self._replicate_global(he_np)
 
+    def _build_vgg_ref_cache(self) -> None:
+        """VGG19 relu5_4 features of every dihedral ref variant, indexed
+        ``[variant, item]`` exactly like the CLAHE table (precache_vgg_ref).
+        One-time ~variants x one VGG epoch at cache build; the step's
+        perceptual term then gathers fy instead of computing vgg(ref) —
+        the ref branch carries no gradient, so this changes numerics only
+        through compile-boundary reassociation (bounded by
+        test_precache_vgg_ref_matches_in_step)."""
+        import numpy as np
+
+        from waternet_tpu.models.vgg import imagenet_normalize
+
+        ref = np.asarray(self._cache_ref)  # host copy, (N, H, W, C) uint8
+        n, h, w, _ = ref.shape
+        b = min(n, max(1, self.config.batch_size))
+        n_var = dihedral_variant_count(h, w)
+        square = h == w
+
+        @jax.jit
+        def feats_all_variants(u8):
+            f = u8.astype(jnp.float32) / 255.0
+            stacked = jnp.concatenate(
+                [dihedral_apply(f, v, square) for v in range(n_var)], axis=0
+            )
+            return self.vgg.apply(self.vgg_params, imagenet_normalize(stacked))
+
+        feats_np = None
+        for start in range(0, n, b):
+            end = min(start + b, n)
+            chunk = ref[start:end]
+            if end - start < b:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], b - (end - start), axis=0)]
+                )
+            keep = end - start
+            f_stack = np.asarray(feats_all_variants(chunk))
+            f_stack = f_stack.reshape((n_var, b) + f_stack.shape[1:])
+            if feats_np is None:
+                feats_np = np.empty(
+                    (n_var, n) + f_stack.shape[2:], f_stack.dtype
+                )
+            feats_np[:, start:end] = f_stack[:, :keep]
+        self._cache_vgg_ref = self._replicate_global(feats_np)
+
     def _cached_index_batches(self, n: int, epoch: int, shuffle: bool):
         """Yield (idx_int32, n_real) covering all n items; the tail batch
         repeats the last index and is masked via n_real (as _pad_batch)."""
@@ -604,6 +710,26 @@ class TrainingEngine:
                 idx = np.concatenate([idx, np.repeat(idx[-1], pad_to - n_real)])
             yield idx.astype(np.int32), n_real
 
+    def cached_train_step(self):
+        """(step_fn, cache_args) for the current cache state — the ONE
+        source of truth for the cached-step dispatch. bench.measure_train
+        and :meth:`train_epoch_cached` both resolve through here, so the
+        benchmark can never measure a different program than training
+        runs. Callers append ``(idx, rng, n_real)`` to ``cache_args``."""
+        if getattr(self, "_cache_raw", None) is None:
+            raise RuntimeError("call cache_dataset() before cached_train_step()")
+        if getattr(self, "_cache_vgg_ref", None) is not None:
+            return self.train_step_cached_pre_vggref, (
+                self._cache_raw, self._cache_ref, self._cache_wb,
+                self._cache_gc, self._cache_he, self._cache_vgg_ref,
+            )
+        if getattr(self, "_cache_he", None) is not None:
+            return self.train_step_cached_pre, (
+                self._cache_raw, self._cache_ref, self._cache_wb,
+                self._cache_gc, self._cache_he,
+            )
+        return self.train_step_cached, (self._cache_raw, self._cache_ref)
+
     def train_epoch_cached(self, epoch: int) -> dict:
         """One epoch over the cached dataset; same metric contract as
         :meth:`train_epoch`. Requires :meth:`cache_dataset` first."""
@@ -623,17 +749,11 @@ class TrainingEngine:
             n, epoch, self.config.shuffle
         ):
             rng = jax.random.fold_in(jax.random.fold_in(base_rng, epoch), count)
-            if getattr(self, "_cache_he", None) is not None:
-                self.state, metrics = self.train_step_cached_pre(
-                    self.state, self._cache_raw, self._cache_ref,
-                    self._cache_wb, self._cache_gc, self._cache_he,
-                    self._replicate_global(idx), rng, n_real,
-                )
-            else:
-                self.state, metrics = self.train_step_cached(
-                    self.state, self._cache_raw, self._cache_ref,
-                    self._replicate_global(idx), rng, n_real,
-                )
+            step_fn, cache_args = self.cached_train_step()
+            self.state, metrics = step_fn(
+                self.state, *cache_args, self._replicate_global(idx), rng,
+                n_real,
+            )
             pending.append(metrics)
             count += 1
         for metrics in pending:
