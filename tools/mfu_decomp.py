@@ -64,12 +64,12 @@ def _compile_step(batch, hw, **overrides):
     rng = jax.random.PRNGKey(0)
     import jax.numpy as jnp
 
-    args = (
-        engine._cache_raw, engine._cache_ref, engine._cache_wb,
-        engine._cache_gc, engine._cache_he, idx_d, rng,
-        jnp.asarray(n_real, jnp.int32),
-    )
-    compiled = engine.train_step_cached_pre.lower(engine.state, *args).compile()
+    # Same dispatch bench/training resolve through, so the decomposition
+    # always describes the program the benchmark measures — including a
+    # future precache_vgg_ref default flip.
+    step_fn, cache_args = engine.cached_train_step()
+    args = (*cache_args, idx_d, rng, jnp.asarray(n_real, jnp.int32))
+    compiled = step_fn.lower(engine.state, *args).compile()
     return engine, _cost(compiled)
 
 

@@ -176,6 +176,10 @@ def main(argv=None):
         if args.host_preprocess:
             raise SystemExit("--device-cache requires device preprocessing")
         engine.cache_dataset(dataset, train_idx)
+    elif args.precache_vgg_ref:
+        # Same contract as cache_dataset's ValueError: an ignored A/B flag
+        # must fail loudly, not silently measure the wrong path.
+        raise SystemExit("--precache-vgg-ref requires --device-cache")
 
     profile_epoch = min(1, args.epochs - 1)  # first post-compilation epoch
     for epoch in range(args.epochs):
