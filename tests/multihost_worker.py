@@ -51,10 +51,14 @@ if mode == "dpsp":
     engine = TrainingEngine(cfg, mesh=make_mesh(n_data=2, n_spatial=2))
 elif mode == "cached":
     # augment=True so the in-step dihedral-variant CLAHE lookup (the
-    # precache path's augmentation machinery) crosses the mesh too.
+    # precache path's augmentation machinery) crosses the mesh too;
+    # perceptual ON + precache_vgg_ref so the dihedral FEATURE table also
+    # replicates through make_array_from_callback and its gather runs
+    # under the multi-process mesh.
     cfg = TrainConfig(
         batch_size=4, im_height=32, im_width=32,
-        precision="fp32", perceptual_weight=0.0, augment=True,
+        precision="fp32", perceptual_weight=0.05, augment=True,
+        precache_vgg_ref=True,
     )
     engine = TrainingEngine(cfg)
 else:
@@ -75,6 +79,7 @@ if mode == "cached":
     ds = SyntheticPairs(6, 32, 32, seed=0)
     engine.cache_dataset(ds, np.arange(6))
     assert engine._cache_he is not None, "precache_histeq did not engage"
+    assert engine._cache_vgg_ref is not None, "precache_vgg_ref did not engage"
     metrics = engine.train_epoch_cached(epoch=0)
     eval_m = engine.eval_epoch_cached()
     metrics = {"loss": metrics["loss"] + eval_m["mse"]}

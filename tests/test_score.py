@@ -196,3 +196,54 @@ def test_nr_native_header_decoder_disagreement(weights_file, tmp_path, rng, monk
         "--batch-size", "4", "--json-out", str(out),
     ])
     assert json.loads(out.read_text())["images"] == 3
+
+
+def test_synth_export_roundtrip(weights_file, tmp_path):
+    """tools/synth_export.py writes the EXACT pairs the trainer's synthetic
+    val split saw (PNG is lossless; pairs are deterministic in
+    (index, seed)), and score.py --split all scores exactly that set."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    import cv2
+
+    import score as cli
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    repo = Path(__file__).resolve().parent.parent
+    out = tmp_path / "synth"
+    proc = subprocess.run(
+        [
+            sys.executable, str(repo / "tools" / "synth_export.py"),
+            "--n", "16", "--height", "32", "--width", "32",
+            "--out", str(out),
+        ],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "HOME": "/root", "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    # train.py's synthetic split: last min(90, 16 // 8) = 2 indices.
+    names = sorted(p.name for p in (out / "raw-890").glob("*.png"))
+    assert names == ["0014.png", "0015.png"]
+    ds = SyntheticPairs(16, 32, 32, seed=0)
+    for i, name in zip((14, 15), names):
+        raw, ref = ds.load_pair(i)
+        got_raw = cv2.cvtColor(
+            cv2.imread(str(out / "raw-890" / name)), cv2.COLOR_BGR2RGB
+        )
+        got_ref = cv2.cvtColor(
+            cv2.imread(str(out / "reference-890" / name)), cv2.COLOR_BGR2RGB
+        )
+        np.testing.assert_array_equal(got_raw, raw)
+        np.testing.assert_array_equal(got_ref, ref)
+
+    mout = tmp_path / "m.json"
+    cli.main([
+        "--weights", str(weights_file), "--data-root", str(out),
+        "--split", "all", "--allow-nonreference-split",
+        "--height", "32", "--width", "32", "--batch-size", "2",
+        "--json-out", str(mout),
+    ])
+    metrics = json.loads(mout.read_text())
+    assert np.isfinite(metrics["mse"]) and -1 <= metrics["ssim"] <= 1

@@ -473,10 +473,15 @@ def test_precache_vgg_ref_matches_in_step():
     assert vr.cached_train_step()[0] is vr.train_step_cached_pre_vggref
     assert plain.cached_train_step()[0] is plain.train_step_cached_pre
 
-    # The flag without its dihedral substrate is an error, not a silent
-    # fall-through to the slow path (an A/B run must never measure nothing).
+    # The flag without its dihedral substrate — or without the perceptual
+    # term it precaches — is an error, not a silent fall-through to the
+    # default path (an A/B run must never measure nothing).
     bad = TrainingEngine(
         TrainConfig(precache_vgg_ref=True, precache_histeq=False, **cfg)
     )
     with pytest.raises(ValueError, match="precache_vgg_ref"):
         bad.cache_dataset(ds, idx)
+    cfg_noperc = dict(cfg, perceptual_weight=0.0)
+    bad2 = TrainingEngine(TrainConfig(precache_vgg_ref=True, **cfg_noperc))
+    with pytest.raises(ValueError, match="precache_vgg_ref"):
+        bad2.cache_dataset(ds, idx)

@@ -1,0 +1,79 @@
+"""Materialize SyntheticPairs as a UIEB-layout directory for score.py.
+
+The synthetic convergence runs train with ``train.py --synthetic N``, whose
+val split is the LAST ``max(1, min(val_size, N // 8))`` indices
+(train.py's synthetic branch) — NOT the torch-permutation split score.py
+reproduces for real UIEB. To score a synthetic-trained checkpoint on
+exactly its own val images, this tool writes those pairs (or the whole
+dataset with ``--all``) as PNGs under ``raw-890/`` + ``reference-890/``;
+score them with::
+
+    python score.py --weights <ckpt> --data-root <out> --split all \
+        --allow-nonreference-split --height <hw> --width <hw>
+
+``--split all`` sidesteps score.py's split logic entirely, so the scored
+set IS the exported set. Pairs are deterministic in (index, seed), so the
+export matches what the trainer saw bit-for-bit.
+
+Usage::
+
+    python tools/synth_export.py --n 64 --height 112 --width 112 \
+        [--seed 0] [--val-size 90] [--all] --out /tmp/synth_uieb
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, required=True,
+                   help="dataset size — must match train.py --synthetic N")
+    p.add_argument("--height", type=int, default=112)
+    p.add_argument("--width", type=int, default=112)
+    p.add_argument("--seed", type=int, default=0,
+                   help="must match the training run's --seed")
+    p.add_argument("--val-size", type=int, default=90,
+                   help="train.py's --val-size at training time (the "
+                   "effective val count is min(val_size, n // 8))")
+    p.add_argument("--all", action="store_true",
+                   help="export every pair instead of only the val split")
+    p.add_argument("--out", required=True)
+    args = p.parse_args()
+
+    import cv2
+    import numpy as np
+
+    from waternet_tpu.data.synthetic import SyntheticPairs, synthetic_split
+
+    ds = SyntheticPairs(args.n, args.height, args.width, seed=args.seed)
+    # Same helper train.py's --synthetic branch uses — the exported val
+    # set is the trainer's val set by construction, not by copied formula.
+    _, val_idx = synthetic_split(args.n, args.val_size)
+    idx = np.arange(args.n) if args.all else val_idx
+
+    out = Path(args.out)
+    raw_dir = out / "raw-890"
+    ref_dir = out / "reference-890"
+    raw_dir.mkdir(parents=True, exist_ok=True)
+    ref_dir.mkdir(parents=True, exist_ok=True)
+    for i in idx:
+        raw, ref = ds.load_pair(int(i))
+        name = f"{int(i):04d}.png"
+        # cv2 writes BGR; the pairs are RGB. imwrite returns False (no
+        # exception) on failure — full disk must not print success.
+        for path, rgb in ((raw_dir / name, raw), (ref_dir / name, ref)):
+            if not cv2.imwrite(str(path), cv2.cvtColor(rgb, cv2.COLOR_RGB2BGR)):
+                raise RuntimeError(f"imwrite failed: {path}")
+    which = "all" if args.all else f"val (last {len(val_idx)})"
+    print(f"exported {len(idx)} {which} pairs of n={args.n} -> {out}")
+
+
+if __name__ == "__main__":
+    main()

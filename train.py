@@ -124,9 +124,9 @@ def main(argv=None):
         dataset = SyntheticPairs(
             args.synthetic, args.height, args.width, seed=args.seed
         )
-        all_idx = np.arange(len(dataset))
-        n_val = max(1, min(args.val_size, len(dataset) // 8))
-        train_idx, val_idx = all_idx[:-n_val], all_idx[-n_val:]
+        from waternet_tpu.data.synthetic import synthetic_split
+
+        train_idx, val_idx = synthetic_split(len(dataset), args.val_size)
     else:
         data_root = Path(args.data_root)
         dataset = UIEBDataset(

@@ -59,3 +59,16 @@ class SyntheticPairs:
         from waternet_tpu.data.batching import iter_batches
 
         return iter_batches(self.load_pair, indices, batch_size, **kwargs)
+
+
+def synthetic_split(n: int, val_size: int = 90):
+    """(train_idx, val_idx) for a synthetic run: the LAST
+    ``max(1, min(val_size, n // 8))`` indices are val — contiguous, no
+    permutation (synthetic pairs are i.i.d. in index, so a shuffle buys
+    nothing). The ONE definition of this split: train.py's --synthetic
+    branch and tools/synth_export.py (which must export exactly the pairs
+    the trainer validated on) both resolve through here.
+    """
+    n_val = max(1, min(val_size, n // 8))
+    idx = np.arange(n)
+    return idx[:-n_val], idx[-n_val:]

@@ -567,24 +567,24 @@ class TrainingEngine:
         still bit-identical (see TrainConfig.precache_histeq).
         """
         if self.config.precache_vgg_ref and not (
-            self.config.precache_histeq and not self.config.host_preprocess
+            self.config.precache_histeq
+            and not self.config.host_preprocess
+            and self.config.perceptual_weight != 0.0
         ):
             # The vggref table rides the same dihedral-variant machinery
-            # (and step variant) as the CLAHE precache; silently ignoring
+            # (and step variant) as the CLAHE precache, and precaches a
+            # term that must actually be in the loss; silently ignoring
             # the flag would let an A/B run measure nothing.
             raise ValueError(
-                "precache_vgg_ref requires precache_histeq=True and "
-                "host_preprocess=False"
+                "precache_vgg_ref requires precache_histeq=True, "
+                "host_preprocess=False, and a nonzero perceptual_weight"
             )
         self._cache_raw, self._cache_ref = self._build_cache(dataset, indices)
         self._cache_wb = self._cache_gc = self._cache_he = None
         self._cache_vgg_ref = None
         if self.config.precache_histeq and not self.config.host_preprocess:
             self._build_transform_cache()
-            if (
-                self.config.precache_vgg_ref
-                and self.config.perceptual_weight != 0.0
-            ):
+            if self.config.precache_vgg_ref:
                 self._build_vgg_ref_cache()
 
     def _build_transform_cache(self) -> None:
