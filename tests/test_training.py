@@ -485,3 +485,56 @@ def test_precache_vgg_ref_matches_in_step():
     bad2 = TrainingEngine(TrainConfig(precache_vgg_ref=True, **cfg_noperc))
     with pytest.raises(ValueError, match="precache_vgg_ref"):
         bad2.cache_dataset(ds, idx)
+
+
+def test_eval_cached_precache_matches_in_step():
+    """The eval-side precache (identity-variant transform tables, and with
+    precache_vgg_ref the feature table too) must score identically to the
+    in-step-transform eval path — same math hoisted out of the step, fp32
+    -> tight tolerance. Covers both the train-cache eval (dataset=None)
+    and the memoized val-cache branch with a tail batch."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    n, bs, hw = 8, 4, 32
+    base = dict(
+        batch_size=bs, im_height=hw, im_width=hw, precision="fp32",
+        perceptual_weight=0.05, shuffle=False, augment=False,
+    )
+    ds = SyntheticPairs(n, hw, hw, seed=0)
+    ds_val = SyntheticPairs(5, hw, hw, seed=3)  # 5 % 4 -> padded tail batch
+    idx = np.arange(n)
+    vidx = np.arange(5)
+
+    plain = TrainingEngine(TrainConfig(precache_histeq=False, **base))
+    params, vggp = plain.state.params, plain.vgg_params
+    plain.cache_dataset(ds, idx)
+    assert plain._train_eval_pre_tables() is None  # in-step path
+    m_plain = plain.eval_epoch_cached()
+    v_plain = plain.eval_epoch_cached(dataset=ds_val, indices=vidx)
+
+    for kw in ({}, {"precache_vgg_ref": True}):
+        eng = TrainingEngine(
+            TrainConfig(**base, **kw), params=params, vgg_params=vggp
+        )
+        eng.cache_dataset(ds, idx)
+        pre = eng._train_eval_pre_tables()
+        assert pre is not None
+        assert (pre[3] is not None) == bool(kw), kw
+        m = eng.eval_epoch_cached()
+        for k in m_plain:
+            assert m[k] == pytest.approx(m_plain[k], rel=1e-4, abs=1e-6), (
+                kw, k, m[k], m_plain[k],
+            )
+        v = eng.eval_epoch_cached(dataset=ds_val, indices=vidx)
+        pre_obj = eng._val_cache_pre
+        assert pre_obj is not None
+        assert (pre_obj[3] is not None) == bool(kw)
+        for k in v_plain:
+            assert v[k] == pytest.approx(v_plain[k], rel=1e-4, abs=1e-6), (
+                kw, k, v[k], v_plain[k],
+            )
+        # Memoization: a repeated (dataset, indices) pair must not rebuild
+        # the pre-tables (metric equality alone can't detect a rebuild —
+        # the pipeline is deterministic — so pin object identity).
+        assert eng.eval_epoch_cached(dataset=ds_val, indices=vidx) == v
+        assert eng._val_cache_pre is pre_obj

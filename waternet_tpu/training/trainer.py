@@ -420,6 +420,52 @@ class TrainingEngine:
             raw_u8, ref_u8 = _gather_cached(cache_raw, cache_ref, idx)
             return eval_step(state, raw_u8, ref_u8, n_real)
 
+        def _eval_cached_pre_body(
+            state: TrainStateT, cache_raw, cache_ref, cache_wb, cache_gc,
+            cache_he, ref_feats, idx, n_real,
+        ):
+            """Eval over the precomputed [variant, item] tables: eval never
+            augments, so the step gathers the identity variant row 0
+            in-step (no sliced duplicate of the table in HBM); with
+            ``ref_feats`` the perceptual metric's vgg(ref) is gathered
+            too. The eval-side twin of _cached_pre_body."""
+            mask = _mask(idx.shape[0], n_real)
+            raw = jnp.take(cache_raw, idx, axis=0).astype(jnp.float32)
+            ref = jnp.take(cache_ref, idx, axis=0).astype(jnp.float32)
+            wb = jnp.take(cache_wb, idx, axis=0).astype(jnp.float32)
+            gc = jnp.take(cache_gc, idx, axis=0).astype(jnp.float32)
+            he = cache_he[0, idx].astype(jnp.float32)
+            fy = ref_feats[0, idx] if ref_feats is not None else None
+            raw, ref, wb, gc, he = (
+                jax.lax.with_sharding_constraint(t, bsh)
+                for t in (raw, ref, wb, gc, he)
+            )
+            x, wbn, hen, gcn, refn = (
+                raw / 255.0, wb / 255.0, he / 255.0, gc / 255.0, ref / 255.0
+            )
+            loss, (out, aux) = self._losses_and_out(
+                state.params, x, wbn, hen, gcn, refn, mask, ref_feats=fy
+            )
+            return self._metrics(out, refn, aux, mask)
+
+        def eval_step_cached_pre(
+            state: TrainStateT, cache_raw, cache_ref, cache_wb, cache_gc,
+            cache_he, idx, n_real,
+        ):
+            return _eval_cached_pre_body(
+                state, cache_raw, cache_ref, cache_wb, cache_gc, cache_he,
+                None, idx, n_real,
+            )
+
+        def eval_step_cached_pre_vggref(
+            state: TrainStateT, cache_raw, cache_ref, cache_wb, cache_gc,
+            cache_he, ref_feats, idx, n_real,
+        ):
+            return _eval_cached_pre_body(
+                state, cache_raw, cache_ref, cache_wb, cache_gc, cache_he,
+                ref_feats, idx, n_real,
+            )
+
         self.train_step = jax.jit(
             train_step,
             in_shardings=(rep, bsh, bsh, rep, rep),
@@ -460,6 +506,16 @@ class TrainingEngine:
         self.eval_step_cached = jax.jit(
             eval_step_cached,
             in_shardings=(rep, rep, rep, rep, rep),
+            out_shardings=rep,
+        )
+        self.eval_step_cached_pre = jax.jit(
+            eval_step_cached_pre,
+            in_shardings=(rep,) * 8,
+            out_shardings=rep,
+        )
+        self.eval_step_cached_pre_vggref = jax.jit(
+            eval_step_cached_pre_vggref,
+            in_shardings=(rep,) * 9,
             out_shardings=rep,
         )
 
@@ -587,18 +643,16 @@ class TrainingEngine:
             if self.config.precache_vgg_ref:
                 self._build_vgg_ref_cache()
 
-    def _build_transform_cache(self) -> None:
-        """Precompute device-path WB/GC and the dihedral CLAHE table for the
-        cached dataset (one-time, ~variants x one epoch of histeq; the
-        steady-state step then runs zero classical transforms)."""
+    def _transform_tables(self, raw, n_var: int):
+        """(wb, gc, he[variants]) uint8 numpy tables for a (N, H, W, C)
+        uint8 array. ``n_var=1`` computes the identity variant only (eval:
+        no augmentation); the full dihedral count feeds training."""
         import numpy as np
 
         from waternet_tpu.ops import gamma_correction, histeq, white_balance
 
-        raw = np.asarray(self._cache_raw)  # host copy, (N, H, W, C) uint8
         n, h, w, _ = raw.shape
         b = min(n, max(1, self.config.batch_size))
-        n_var = dihedral_variant_count(h, w)
         square = h == w
 
         @jax.jit
@@ -635,26 +689,30 @@ class TrainingEngine:
             gc_np[start:end] = np.asarray(gc_c)[:keep].astype(np.uint8)
             he_stack = np.asarray(he_all_variants(chunk)).astype(np.uint8)
             he_np[:, start:end] = he_stack.reshape(n_var, b, h, w, -1)[:, :keep]
+        return wb_np, gc_np, he_np
+
+    def _build_transform_cache(self) -> None:
+        """Precompute device-path WB/GC and the dihedral CLAHE table for the
+        cached dataset (one-time, ~variants x one epoch of histeq; the
+        steady-state step then runs zero classical transforms)."""
+        import numpy as np
+
+        raw = np.asarray(self._cache_raw)  # host copy, (N, H, W, C) uint8
+        n_var = dihedral_variant_count(raw.shape[1], raw.shape[2])
+        wb_np, gc_np, he_np = self._transform_tables(raw, n_var)
         self._cache_wb = self._replicate_global(wb_np)
         self._cache_gc = self._replicate_global(gc_np)
         self._cache_he = self._replicate_global(he_np)
 
-    def _build_vgg_ref_cache(self) -> None:
-        """VGG19 relu5_4 features of every dihedral ref variant, indexed
-        ``[variant, item]`` exactly like the CLAHE table (precache_vgg_ref).
-        One-time ~variants x one VGG epoch at cache build; the step's
-        perceptual term then gathers fy instead of computing vgg(ref) —
-        the ref branch carries no gradient, so this changes numerics only
-        through compile-boundary reassociation (bounded by
-        test_precache_vgg_ref_matches_in_step)."""
+    def _vgg_ref_table(self, ref, n_var: int):
+        """[variant, item] VGG19 relu5_4 feature table for a (N, H, W, C)
+        uint8 ref array — ``n_var=1`` for eval (identity variant only)."""
         import numpy as np
 
         from waternet_tpu.models.vgg import imagenet_normalize
 
-        ref = np.asarray(self._cache_ref)  # host copy, (N, H, W, C) uint8
         n, h, w, _ = ref.shape
         b = min(n, max(1, self.config.batch_size))
-        n_var = dihedral_variant_count(h, w)
         square = h == w
 
         @jax.jit
@@ -681,7 +739,23 @@ class TrainingEngine:
                     (n_var, n) + f_stack.shape[2:], f_stack.dtype
                 )
             feats_np[:, start:end] = f_stack[:, :keep]
-        self._cache_vgg_ref = self._replicate_global(feats_np)
+        return feats_np
+
+    def _build_vgg_ref_cache(self) -> None:
+        """VGG19 relu5_4 features of every dihedral ref variant, indexed
+        ``[variant, item]`` exactly like the CLAHE table (precache_vgg_ref).
+        One-time ~variants x one VGG epoch at cache build; the step's
+        perceptual term then gathers fy instead of computing vgg(ref) —
+        the ref branch carries no gradient, so this changes numerics only
+        through compile-boundary reassociation (bounded by
+        test_precache_vgg_ref_matches_in_step)."""
+        import numpy as np
+
+        ref = np.asarray(self._cache_ref)  # host copy, (N, H, W, C) uint8
+        n_var = dihedral_variant_count(ref.shape[1], ref.shape[2])
+        self._cache_vgg_ref = self._replicate_global(
+            self._vgg_ref_table(ref, n_var)
+        )
 
     def _cached_index_batches(self, n: int, epoch: int, shuffle: bool):
         """Yield (idx_int32, n_real) covering all n items; the tail batch
@@ -709,6 +783,46 @@ class TrainingEngine:
             if n_real < pad_to:
                 idx = np.concatenate([idx, np.repeat(idx[-1], pad_to - n_real)])
             yield idx.astype(np.int32), n_real
+
+    def _build_eval_pre_tables(self, cache_pair):
+        """Identity-variant transform (and, with precache_vgg_ref, feature)
+        tables for an eval cache as 1-variant [variant, item] arrays, or
+        None when precaching is off. Eval never augments, so one variant
+        covers it — the per-epoch val pass then runs zero classical
+        transforms (and no vgg(ref) forward), mirroring the train-side
+        precache."""
+        if not (
+            self.config.precache_histeq and not self.config.host_preprocess
+        ):
+            return None
+        import numpy as np
+
+        cache_raw, cache_ref = cache_pair
+        wb_np, gc_np, he_np = self._transform_tables(np.asarray(cache_raw), 1)
+        feats = None
+        if (
+            self.config.precache_vgg_ref
+            and self.config.perceptual_weight != 0.0
+        ):
+            feats = self._replicate_global(
+                self._vgg_ref_table(np.asarray(cache_ref), 1)
+            )
+        return (
+            self._replicate_global(wb_np),
+            self._replicate_global(gc_np),
+            self._replicate_global(he_np),
+            feats,
+        )
+
+    def _train_eval_pre_tables(self):
+        """The train cache's own [variant, item] tables for eval (the step
+        gathers variant 0 in-step — no duplicated HBM)."""
+        if getattr(self, "_cache_he", None) is None:
+            return None
+        return (
+            self._cache_wb, self._cache_gc, self._cache_he,
+            getattr(self, "_cache_vgg_ref", None),
+        )
 
     def cached_train_step(self):
         """(step_fn, cache_args) for the current cache state — the ONE
@@ -772,23 +886,36 @@ class TrainingEngine:
             key = (_cache_token(dataset), tuple(int(i) for i in indices))
             if getattr(self, "_val_cache_key", None) != key:
                 self._val_cache = self._build_cache(dataset, indices)
+                self._val_cache_pre = self._build_eval_pre_tables(
+                    self._val_cache
+                )
                 self._val_cache_key = key
             cache_raw, cache_ref = self._val_cache
+            pre = self._val_cache_pre
         else:
             if getattr(self, "_cache_raw", None) is None:
                 raise RuntimeError("no cached dataset for eval_epoch_cached()")
             cache_raw, cache_ref = self._cache_raw, self._cache_ref
+            pre = self._train_eval_pre_tables()
         sums = {k: 0.0 for k in VAL_METRICS_NAMES}
         count = 0
         pending = []
         n = cache_raw.shape[0]
         for idx, n_real in self._cached_index_batches(n, epoch=0, shuffle=False):
-            pending.append(
-                self.eval_step_cached(
-                    self.state, cache_raw, cache_ref,
-                    self._replicate_global(idx), n_real,
+            idx_g = self._replicate_global(idx)
+            if pre is None:
+                m = self.eval_step_cached(
+                    self.state, cache_raw, cache_ref, idx_g, n_real
                 )
-            )
+            elif pre[3] is not None:
+                m = self.eval_step_cached_pre_vggref(
+                    self.state, cache_raw, cache_ref, *pre, idx_g, n_real
+                )
+            else:
+                m = self.eval_step_cached_pre(
+                    self.state, cache_raw, cache_ref, *pre[:3], idx_g, n_real
+                )
+            pending.append(m)
             count += 1
         for metrics in pending:
             for k in sums:
