@@ -87,3 +87,22 @@ def test_backstop_mirrors_bench_default(ab):
     assert '_env_int("WATERNET_BENCH_TIMEOUT", 900)' in inspect.getsource(
         bench.main
     )
+
+
+def test_run_bench_ignores_scalar_json_lines(ab, monkeypatch, tmp_path):
+    """Non-object JSON stdout lines (a stray debug number, 'null') must be
+    skipped, not crash the sweep mid-tunnel-session."""
+    _stub_bench(
+        tmp_path,
+        "import json\n"
+        "print(42)\n"
+        "print('null')\n"
+        "print(json.dumps({'metric': 'uieb_train_images_per_sec_per_chip"
+        "_hostfed', 'value': 300.0}))\n"
+        "print(json.dumps({'metric': 'uieb_train_images_per_sec_per_chip',"
+        " 'value': 600.0}))\n",
+    )
+    monkeypatch.setattr(ab, "REPO", tmp_path)
+    line = ab.run_bench({}, timeout=60)
+    assert line["value"] == 600.0
+    assert line["hostfed_line"]["value"] == 300.0

@@ -111,9 +111,11 @@ def run_bench(extra_env, args=(), timeout=None):
     lines = []
     for out_line in stdout.strip().splitlines():
         try:
-            lines.append(json.loads(out_line))
+            parsed = json.loads(out_line)
         except json.JSONDecodeError:
             continue
+        if isinstance(parsed, dict):  # scalar JSON (stray number) != result
+            lines.append(parsed)
     line = lines[-1] if lines else None
     if line is not None:
         for extra in lines[:-1]:
@@ -164,6 +166,10 @@ def main():
             ("video_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
             ("video_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter"}),
             ("video_int8", {"WATERNET_QUANT": "1"}),
+            # Chunk cap / one-hot dtype bind only at full-res tile areas
+            # (docs/CLAHE_1080.md) — hence video stages, not train ones.
+            ("video_cap_8mb", {"WATERNET_CLAHE_MATMUL_CAP_MB": "8"}),
+            ("video_onehot_bf16", {"WATERNET_CLAHE_ONEHOT": "bf16"}),
         ):
             print(f"[ab_bench] {name}", file=sys.stderr)
             report["video"][name] = run_bench(

@@ -1,0 +1,73 @@
+"""Parse a train.py run log into the docs/RESULTS.md convergence table.
+
+The 400-epoch synthetic convergence run writes metrics CSVs only at
+completion (train.py emits them post-loop), but the live log carries the
+per-epoch metric lines — this parses them into the markdown row format
+used by docs/RESULTS.md, printing rows for the requested epochs plus the
+latest, so the harvest is one copy-paste (or `--markdown` for the block).
+
+Usage::
+
+    python tools/harvest_convergence.py output/convergence_r5.log \
+        [--epochs 1,30,50,100,150,200,250,300,400]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+
+
+def parse_log(text: str):
+    rows = []
+    pat = re.compile(
+        r"Epoch (\d+)/\d+ \[train ([\d.]+)s.*?\n"
+        r".*?\n\s+Val\s+\|\| mse: ([\d.e+]+)\s+ssim: ([\d.]+)\s+"
+        r"psnr: ([\d.]+)\s+perceptual_loss: ([\d.e+-]+)"
+    )
+    for m in pat.finditer(text):
+        rows.append(
+            {
+                "epoch": int(m.group(1)),
+                "train_s": float(m.group(2)),
+                "mse": float(m.group(3)),
+                "ssim": float(m.group(4)),
+                "psnr": float(m.group(5)),
+                "perceptual": float(m.group(6)),
+            }
+        )
+    return rows
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("log")
+    p.add_argument("--epochs", default="1,30,50,100,150,200,250,300,350,400")
+    args = p.parse_args()
+    rows = parse_log(open(args.log).read())
+    if not rows:
+        raise SystemExit("no epoch lines found")
+    by_epoch = {r["epoch"]: r for r in rows}
+    want = [int(e) for e in args.epochs.split(",")]
+    best = max(rows, key=lambda r: r["ssim"])
+    print("| epoch | val MSE | val SSIM | val PSNR | val perceptual |")
+    print("|---|---|---|---|---|")
+    picked = [by_epoch[e] for e in want if e in by_epoch]
+    last = rows[-1]
+    if last not in picked:
+        picked.append(last)
+    for r in picked:
+        tag = " (final)" if r is rows[-1] else ""
+        print(
+            f"| {r['epoch']}{tag} | {r['mse']:.0f} | {r['ssim']:.3f} "
+            f"| {r['psnr']:.1f} | {r['perceptual']:.4f} |"
+        )
+    wall_h = sum(r["train_s"] for r in rows) / 3600
+    print(
+        f"\nepochs: {len(rows)}, best val SSIM {best['ssim']:.3f} "
+        f"(epoch {best['epoch']}), ~{wall_h:.1f} h train wall"
+    )
+
+
+if __name__ == "__main__":
+    main()

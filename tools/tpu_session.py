@@ -53,10 +53,13 @@ AB_VARIANTS = [
     # on hardware. Standard elementwise lowering — safe to run first.
     ("srgb_float", {"WATERNET_SRGB_TRANSFER": "float"}),
     ("fp32", {"_precision": "fp32"}),
-    # Round-5 matmul-path knobs (safe lowerings): one-hot operand dtype
-    # (int8 default vs bf16) and the chunk cap (docs/CLAHE_1080.md).
+    # Round-5 matmul-path knob (safe lowering): one-hot operand dtype,
+    # int8 default vs bf16 (docs/CLAHE_1080.md). NOTE the chunk-cap knob
+    # is deliberately NOT a train-sweep variant: at 112x112 the tile area
+    # (196 px) is under the 256-element chunk floor, so no cap can bind —
+    # the A/B would measure a byte-identical program. The cap A/B lives in
+    # the 1080p device-resident video stages, where it binds.
     ("clahe_onehot_bf16", {"WATERNET_CLAHE_ONEHOT": "bf16"}),
-    ("clahe_cap_16mb", {"WATERNET_CLAHE_MATMUL_CAP_MB": "16"}),
     ("clahe_hist_pallas", {"WATERNET_CLAHE_HIST": "pallas"}),
     ("clahe_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul"}),
     ("clahe_hist_matmul", {"WATERNET_CLAHE_HIST": "matmul"}),
@@ -632,6 +635,23 @@ def main():
                 hw=(vh, vh * 16 // 9), batch=4, steps=12, quantize=True
             ),
         )
+        # 1080p CLAHE matmul-path A/Bs at the shape where the knobs BIND
+        # (tile area 135x240 px — see docs/CLAHE_1080.md; at the 112x112
+        # train shape these are no-ops): chunk cap and one-hot dtype.
+        for suffix, env in (
+            ("cap8mb", {"WATERNET_CLAHE_MATMUL_CAP_MB": "8"}),
+            ("onehot_bf16", {"WATERNET_CLAHE_ONEHOT": "bf16"}),
+        ):
+            undo = _env_patch(env)
+            try:
+                s.run_stage(
+                    f"video_{vh}p_device_resident_{suffix}",
+                    lambda: bench.bench_video_device_resident(
+                        hw=(vh, vh * 16 // 9), batch=4, steps=12
+                    ),
+                )
+            finally:
+                undo()
         # Throughput-optimal batch: the reference-parity headline is batch
         # 16; the 16/32/64 points form the single-chip batch-scaling curve
         # (the DP-efficiency proxy this env can measure with one chip).
