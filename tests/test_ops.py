@@ -530,6 +530,9 @@ def test_clahe_onehot_dtype_modes_bitexact(rng, monkeypatch):
 
     clahe_mod = importlib.import_module("waternet_tpu.ops.clahe")
     monkeypatch.setenv("WATERNET_CLAHE_HIST", "matmul")
+    # The dtype knob governs BOTH matmul paths; interp=matmul exercises the
+    # int8 value-minus-128 table trick (odd th -> degraded cells) too.
+    monkeypatch.setenv("WATERNET_CLAHE_INTERP", "matmul")
     lum = rng.integers(0, 256, size=(136, 240), dtype=np.uint8)
     want = cv2.createCLAHE(clipLimit=0.1, tileGridSize=(8, 8)).apply(lum)
     for dtype in ("int8", "bf16", "f32"):

@@ -149,15 +149,24 @@ def main():
             lambda t: cl._tile_hist(t, None), tiles, reps=args.reps
         )
     os.environ.pop("WATERNET_CLAHE_ONEHOT", None)
-    for mode in ("gather", "matmul"):
-        os.environ["WATERNET_CLAHE_HIST"] = "scatter"
-        os.environ["WATERNET_CLAHE_INTERP"] = mode
-        # NB: fresh lambda per variant — the strategy envs are read at
-        # trace time and jax's tracing cache keys on the function object,
-        # so passing cl.clahe itself would silently reuse the first trace.
-        report["stages"][f"clahe_core_interp_{mode}"] = measure(
+    # NB: fresh lambda per variant — the strategy envs are read at trace
+    # time and jax's tracing cache keys on the function object, so passing
+    # cl.clahe itself would silently reuse the first trace.
+    os.environ["WATERNET_CLAHE_HIST"] = "scatter"
+    os.environ["WATERNET_CLAHE_INTERP"] = "gather"
+    report["stages"]["clahe_core_interp_gather"] = measure(
+        lambda x: cl.clahe(x), lum, reps=args.reps
+    )
+    # The one-hot dtype governs the interp tables too (value-128 int8
+    # trick) — sweep it here so the int8-vs-bf16 interp A/B is always a
+    # same-run comparison.
+    for dt in ("int8", "bf16"):
+        os.environ["WATERNET_CLAHE_INTERP"] = "matmul"
+        os.environ["WATERNET_CLAHE_ONEHOT"] = dt
+        report["stages"][f"clahe_core_interp_matmul_onehot_{dt}"] = measure(
             lambda x: cl.clahe(x), lum, reps=args.reps
         )
+    os.environ.pop("WATERNET_CLAHE_ONEHOT", None)
     lab = np.asarray(rgb_to_lab_u8(rgb))
     report["stages"]["lab_to_rgb"] = measure(lab_u8_to_rgb, lab, reps=args.reps)
 

@@ -102,12 +102,17 @@ def _matmul_cap_bytes() -> int:
 
 
 def _onehot_dtypes():
-    """(operand dtype, accumulator dtype) for the histogram one-hot matmul.
+    """(operand dtype, accumulator dtype) for BOTH one-hot matmul paths
+    (histograms and LUT interpolation).
 
-    ``int8`` (default) halves the dominant one-hot byte stream vs bf16 and
-    uses the MXU's native int8 path with int32 accumulation; every product
-    is 0/1 and tile areas are < 2^24, so counts are exact in any of these.
-    ``WATERNET_CLAHE_ONEHOT`` selects bf16/f32 for hardware A/B.
+    ``int8`` (default) halves the dominant one-hot byte streams vs bf16
+    and uses the MXU's native int8 path with int32 accumulation — exact
+    for both uses: histogram products are 0/1 with tile-area sums < 2^24,
+    and the interpolation stores LUT values (integers 0..255) as
+    ``value - 128`` (fits int8 exactly), adding 128 back after the matmul
+    — each output element is one ``1 * (v - 128)`` product, so the
+    round-trip is the identity. ``WATERNET_CLAHE_ONEHOT`` selects
+    bf16/f32 for hardware A/B.
     """
     mode = os.environ.get("WATERNET_CLAHE_ONEHOT", "int8").strip().lower()
     if mode == "int8":
@@ -260,13 +265,14 @@ def _fit_cell_rows(cell_h, cells_y, cell_w, wp):
     Every pixel of a cell shares its tile pair, so any divisor of cell_h
     still yields constant cells (entries repeat). Returns the adjusted
     (cell_h, cells_y), or None when even single-pixel rows can't fit —
-    per-row table bytes depend only on ncx, so that's the ncx*2048 > cap
-    degenerate case (both tiles odd at extreme widths)."""
+    per-row table bytes depend only on ncx, so that's the degenerate
+    all-tables case (both tiles odd at extreme widths)."""
+    isz = jnp.dtype(_onehot_dtypes()[0]).itemsize
     ncx = wp // cell_w
-    tables_row = ncx * 256 * 4 * 2
+    tables_row = ncx * 256 * 4 * isz
 
     def row_bytes(ch):
-        return max(ncx * ch * cell_w * 256 * 2, tables_row)
+        return max(ncx * ch * cell_w * 256 * isz, tables_row)
 
     cap = _matmul_cap_bytes()
     d = cell_h
@@ -289,12 +295,16 @@ def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, cell_h, cell_w):
     four tile LUTs (the cell index determines floor(y/th - 0.5) etc.).
     Stacking those four 256-entry LUTs per cell gives a (cells, 256, 4)
     operand, and the pixel values become a (cells, pix, 256) one-hot; a
-    bf16 batched matmul then performs all four lookups per pixel on the
-    MXU. Exact: each output element is a single 1.0 * lut product (LUT
-    values are integers <= 255, exactly representable in bf16), so the
-    result is bit-identical to the gather path. Cell rows are processed in
-    lax.scan groups sized so the one-hot (and the per-group tables) stay
-    under the :func:`_matmul_cap_bytes` cap at any frame size.
+    batched matmul then performs all four lookups per pixel on the MXU.
+    Exact in every operand dtype (see :func:`_onehot_dtypes`): each output
+    element is a single ``1 * value`` product — in bf16/f32 the LUT
+    values (integers <= 255) are exactly representable; in int8 (the
+    default, half the byte traffic) the tables store ``value - 128``
+    (fits int8 exactly) and 128 is added back after the int32-accumulated
+    matmul — so the result is bit-identical to the gather path. Cell rows
+    are processed in lax.scan groups sized so the one-hot (and the
+    per-group tables) stay under the :func:`_matmul_cap_bytes` cap at any
+    frame size.
 
     Returns four (hp, wp) float32 planes (quadrants 11, 12, 21, 22).
     """
@@ -303,10 +313,13 @@ def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, cell_h, cell_w):
     x1, x2 = cells_x
     ncy, ncx = len(y1), len(x1)
     x1j, x2j = jnp.asarray(x1), jnp.asarray(x2)
+    dt, acc_dt = _onehot_dtypes()
+    isz = jnp.dtype(dt).itemsize
+    offset = jnp.float32(128.0) if dt == jnp.int8 else jnp.float32(0.0)
 
     # Largest divisor of ncy for which BOTH per-group operands (one-hot and
     # LUT tables) fit the cap.
-    per_row = max(ncx * cell_h * cell_w * 256 * 2, ncx * 256 * 4 * 2)
+    per_row = max(ncx * cell_h * cell_w * 256 * isz, ncx * 256 * 4 * isz)
     budget = max(_matmul_cap_bytes() // per_row, 1)
     g = max(d for d in range(1, ncy + 1) if ncy % d == 0 and d <= budget)
     n_groups = ncy // g
@@ -319,19 +332,20 @@ def _lut_planes_matmul(luts, v_pad, cells_y, cells_x, cell_h, cell_w):
         tables = jnp.stack(
             [tab(y1g, x1j), tab(y1g, x2j), tab(y2g, x1j), tab(y2g, x2j)],
             axis=-1,
-        ).reshape(g * ncx, 256, 4).astype(jnp.bfloat16)
+        ).reshape(g * ncx, 256, 4)
+        tables = (tables - offset).astype(dt)
         cells = (
             vg.reshape(g, cell_h, ncx, cell_w)
             .transpose(0, 2, 1, 3)
             .reshape(g * ncx, cell_h * cell_w)
         )
-        onehot = jax.nn.one_hot(cells, 256, dtype=jnp.bfloat16)
+        onehot = jax.nn.one_hot(cells, 256, dtype=dt)
         looked = jax.lax.dot_general(
             onehot,
             tables,
             (((2,), (1,)), ((0,), (0,))),  # contract the 256 bins, batch cells
-            preferred_element_type=jnp.float32,
-        )  # (cells, pix, 4)
+            preferred_element_type=acc_dt,
+        ).astype(jnp.float32) + offset  # (cells, pix, 4)
         return (
             looked.reshape(g, ncx, cell_h, cell_w, 4)
             .transpose(4, 0, 2, 1, 3)
