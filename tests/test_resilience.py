@@ -1,0 +1,541 @@
+"""Resilience subsystem tests: preemption-safe checkpointing, divergence
+containment, checkpoint manager integrity/retention, hardened ingestion,
+and the fault-injection harness itself.
+
+The headline guarantees, each pinned here via deterministic fault
+injection (`waternet_tpu/resilience/faults.py`):
+
+* SIGTERM at an arbitrary step yields a resumable checkpoint and the
+  resumed run's artifacts are BYTE-identical to an uninterrupted run, on
+  both the host-fed and --device-cache paths;
+* an injected NaN step triggers rollback + bounded skip (run completes
+  with finite metrics and reported counters) instead of corrupting state;
+* a truncated checkpoint is detected and --resume auto falls back to the
+  previous good one.
+"""
+
+import json
+import math
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from waternet_tpu.resilience import faults
+
+ARGS = [
+    "--synthetic", "8", "--batch-size", "4", "--height", "32", "--width", "32",
+    "--no-perceptual", "--precision", "fp32",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _tiny_config(**kw):
+    from waternet_tpu.training.trainer import TrainConfig
+
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("im_height", 32)
+    kw.setdefault("im_width", 32)
+    kw.setdefault("precision", "fp32")
+    kw.setdefault("perceptual_weight", 0.0)
+    return TrainConfig(**kw)
+
+
+def _run_cli(tmp_base, name, argv, monkeypatch):
+    """Run train.py's main with run dirs redirected under tmp_base."""
+    import train as cli
+    import waternet_tpu.utils.rundir as rundir
+
+    d = Path(tmp_base) / name
+    monkeypatch.setattr(rundir, "next_run_dir", lambda base, name=None: d)
+    monkeypatch.setattr(
+        rundir,
+        "run_dirs_desc",
+        lambda base: sorted(
+            (p for p in Path(tmp_base).iterdir() if p.is_dir()),
+            key=lambda p: p.stat().st_mtime,
+            reverse=True,
+        ),
+    )
+    cli.main(ARGS + argv)
+    return d
+
+
+# ----------------------------------------------------------------------
+# Fault harness
+# ----------------------------------------------------------------------
+
+
+def test_fault_plan_parse_and_one_shot():
+    plan = faults.FaultPlan.parse("nan@3, sigterm@10")
+    assert plan.fire("nan", 3) is True
+    assert plan.fire("nan", 3) is False  # one-shot
+    assert plan.fire("sigterm", 9) is False
+    assert plan.fire("sigterm", 10) is True
+    assert not plan  # exhausted
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultPlan.parse("meteor@1")
+
+
+def test_truncate_file(tmp_path):
+    f = tmp_path / "blob.bin"
+    f.write_bytes(b"x" * 1000)
+    faults.truncate_file(f, keep_bytes=10)
+    assert f.stat().st_size == 10
+
+
+# ----------------------------------------------------------------------
+# Atomic weight saves
+# ----------------------------------------------------------------------
+
+
+def test_save_weights_atomic_keeps_previous_on_failure(tmp_path, monkeypatch):
+    from waternet_tpu.utils.checkpoint import load_weights, save_weights
+
+    params = {"a": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    path = tmp_path / "last.npz"
+    save_weights(params, path)
+
+    def _boom(file, **arrays):
+        Path(file).write_bytes(b"partial garbage")
+        raise OSError("disk full")
+
+    monkeypatch.setattr(np, "savez", _boom)
+    with pytest.raises(OSError):
+        save_weights({"a": {"w": np.zeros((2, 3), np.float32)}}, path)
+    # The original file is intact and loadable; no temp litter remains.
+    restored = load_weights(path)
+    assert np.array_equal(restored["a"]["w"], params["a"]["w"])
+    assert list(tmp_path.glob("*.tmp.npz")) == []
+    assert list(tmp_path.glob(".*")) == []
+
+
+# ----------------------------------------------------------------------
+# Restore mismatch diagnostics
+# ----------------------------------------------------------------------
+
+
+def test_restore_mismatch_names_param_path(tmp_path):
+    import jax
+
+    from waternet_tpu.training.trainer import TrainingEngine
+    from waternet_tpu.utils.checkpoint import save_state_atomic
+
+    eng = TrainingEngine(_tiny_config())
+    st = jax.device_get(eng.state)
+    st.params["params"]["cmg"]["Conv_0"]["kernel"] = np.zeros(
+        (3, 3, 12, 99), np.float32
+    )
+    save_state_atomic(st, tmp_path / "ckpt")
+    fresh = TrainingEngine(_tiny_config())
+    with pytest.raises(ValueError) as ei:
+        fresh.restore(tmp_path / "ckpt")
+    msg = str(ei.value)
+    assert "params/cmg/Conv_0/kernel" in msg
+    assert "(3, 3, 12, 99)" in msg and "(7, 7, 12, 128)" in msg
+
+
+def test_host_preprocess_midepoch_resume_matches_uninterrupted():
+    """Host-augment fast-forward must mirror PADDED batch consumption.
+
+    conftest forces 8 CPU devices, so batch 4 pads to 8 rows and the padded
+    rows consume augment draws too; a resume that advanced the stream by
+    item count only would diverge silently."""
+    import jax
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    cfg = _tiny_config(host_preprocess=True)
+    ds = SyntheticPairs(8, 32, 32, seed=0)
+    batches = list(ds.batches(np.arange(8), 4, shuffle=False))
+
+    full = TrainingEngine(cfg)
+    full.train_epoch(iter(batches), epoch=0)
+
+    resumed = TrainingEngine(cfg)
+    resumed.train_epoch(iter(batches[:1]), epoch=0)
+    resumed.train_epoch(
+        iter(batches[1:]), epoch=0, start_batch=1, start_items=4
+    )
+    a = jax.tree_util.tree_leaves(jax.device_get(full.state))
+    b = jax.tree_util.tree_leaves(jax.device_get(resumed.state))
+    assert all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+
+# ----------------------------------------------------------------------
+# Checkpoint manager: markers, retention, fallback
+# ----------------------------------------------------------------------
+
+
+def test_manager_retention_keeps_last_n_plus_best(tmp_path):
+    from waternet_tpu.resilience import CheckpointManager
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    eng = TrainingEngine(_tiny_config())
+    mgr = CheckpointManager(tmp_path / "ck", keep=2)
+    psnrs = {1: 10.0, 2: 30.0, 3: 12.0, 4: 11.0, 5: 13.0}
+    for step, psnr in psnrs.items():
+        mgr.save(eng, meta={"step": step, "val_psnr": psnr})
+    kept = sorted(ck.step for ck in mgr.checkpoints())
+    # last 2 (steps 4, 5) + best-by-PSNR (step 2)
+    assert kept == [2, 4, 5]
+
+
+def test_manager_skips_unfinalized_and_falls_back_past_corrupt(tmp_path):
+    import jax
+
+    from waternet_tpu.resilience import CheckpointManager
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    eng = TrainingEngine(_tiny_config())
+    mgr = CheckpointManager(tmp_path / "ck", keep=5)
+    mgr.save(eng, meta={"step": 1})
+    eng.state = eng.state.replace(step=eng.state.step + 1)
+    eng._host_step = 2
+    mgr.save(eng, meta={"step": 2})
+    # A half-written checkpoint: directory exists, no _COMPLETE marker.
+    (tmp_path / "ck" / "step-0000000003").mkdir()
+    assert [ck.step for ck in mgr.checkpoints()] == [1, 2]
+
+    # Corrupt the newest finalized checkpoint: fallback to step 1.
+    victim = faults.largest_file(tmp_path / "ck" / "step-0000000002" / "state")
+    faults.truncate_file(victim, keep_bytes=8)
+    fresh = TrainingEngine(_tiny_config())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ck = mgr.restore_latest_good(fresh)
+    assert ck is not None and ck.step == 1
+    assert int(jax.device_get(fresh.state.step)) == int(
+        jax.device_get(eng.state.step)
+    ) - 1
+
+
+def test_resume_auto_aborts_on_config_mismatch(tmp_path):
+    """A model-config mismatch is not corruption: --resume auto must stop
+    with the shape report, not fall back through every checkpoint and
+    silently retrain from scratch."""
+    import jax
+
+    from waternet_tpu.resilience import CheckpointManager
+    from waternet_tpu.training.trainer import (
+        CheckpointMismatchError,
+        TrainingEngine,
+    )
+
+    eng = TrainingEngine(_tiny_config())
+    st = jax.device_get(eng.state)
+    st.params["params"]["cmg"]["Conv_0"]["kernel"] = np.zeros(
+        (3, 3, 12, 99), np.float32
+    )
+    mgr = CheckpointManager(tmp_path / "ck")
+    eng.state = jax.device_put(st)  # save the doctored tree
+    mgr.save(eng, meta={"step": 1})
+
+    fresh = TrainingEngine(_tiny_config())
+    with pytest.raises(CheckpointMismatchError, match="cmg/Conv_0/kernel"):
+        mgr.restore_latest_good(fresh)
+
+
+def test_auto_resume_fresh_cases(tmp_path):
+    from waternet_tpu.resilience import auto_resume
+
+    class _NeverRestore:
+        def restore(self, path):  # pragma: no cover - must not be called
+            raise AssertionError("restore called on fresh start")
+
+    # No training base at all.
+    assert auto_resume(_NeverRestore(), tmp_path / "nope") is None
+    # A latest run with neither checkpoints/ nor state/.
+    (tmp_path / "training" / "0").mkdir(parents=True)
+    assert auto_resume(_NeverRestore(), tmp_path / "training") is None
+
+
+def test_auto_resume_legacy_state_dir(tmp_path):
+    import jax
+
+    from waternet_tpu.resilience import auto_resume
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    eng = TrainingEngine(_tiny_config())
+    eng.state = eng.state.replace(step=eng.state.step + 7)
+    run = tmp_path / "training" / "0"
+    run.mkdir(parents=True)
+    eng.checkpoint(run / "state")
+
+    fresh = TrainingEngine(_tiny_config())
+    meta = auto_resume(fresh, tmp_path / "training")
+    assert meta == {}  # legacy: restored, but no position metadata
+    assert int(jax.device_get(fresh.state.step)) == 7
+
+
+# ----------------------------------------------------------------------
+# Divergence sentinel
+# ----------------------------------------------------------------------
+
+
+def test_nan_fault_rollback_and_skip(tmp_path):
+    """An injected NaN step is contained: rollback + skip, finite result,
+    counters reported — and the final state matches a run that never saw
+    the poisoned batch."""
+    import jax
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.resilience import DivergenceSentinel, EpochControl
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    ds = SyntheticPairs(16, 32, 32, seed=0)
+    idx = np.arange(16)
+
+    eng = TrainingEngine(_tiny_config())
+    faults.install(faults.FaultPlan.parse("nan@2"))
+    sentinel = DivergenceSentinel(window=2)
+    control = EpochControl(sentinel=sentinel)
+    m = eng.train_epoch(
+        ds.batches(idx, 4, shuffle=False), epoch=0, control=control
+    )
+    faults.clear()
+    assert sentinel.skipped == 1 and sentinel.rollbacks == 1
+    assert m["nan_skipped"] == 1.0
+    assert all(math.isfinite(v) for v in m.values())
+    leaves = jax.tree_util.tree_leaves(jax.device_get(eng.state))
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+
+    # Reference: train on the same epoch with batch 1 (the poisoned step)
+    # removed, at the same per-batch rng positions — rollback-and-skip must
+    # land on exactly this state.
+    ref = TrainingEngine(_tiny_config())
+    batches = list(ds.batches(idx, 4, shuffle=False))
+    ref.train_epoch(iter(batches[:1]), epoch=0)
+    ref.train_epoch(iter(batches[2:]), epoch=0, start_batch=2)
+    a = jax.tree_util.tree_leaves(jax.device_get(eng.state))
+    b = jax.tree_util.tree_leaves(jax.device_get(ref.state))
+    # step counters differ by the skipped batch's dispatch count; params
+    # and moments must be identical.
+    mismatch = [
+        1
+        for x, y in zip(a, b)
+        if np.asarray(x).shape == np.asarray(y).shape
+        and np.asarray(x).dtype.kind == "f"
+        and not np.array_equal(np.asarray(x), np.asarray(y))
+    ]
+    assert not mismatch
+
+
+def test_divergence_budget_exhaustion_raises():
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.resilience import (
+        DivergenceError,
+        DivergenceSentinel,
+        EpochControl,
+    )
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    ds = SyntheticPairs(16, 32, 32, seed=0)
+    eng = TrainingEngine(_tiny_config())
+    faults.install(faults.FaultPlan.parse("nan@1,nan@2,nan@3"))
+    control = EpochControl(sentinel=DivergenceSentinel(window=1, max_skips=1))
+    with pytest.raises(DivergenceError):
+        eng.train_epoch(
+            ds.batches(np.arange(16), 4, shuffle=False),
+            epoch=0,
+            control=control,
+        )
+    faults.clear()
+
+
+# ----------------------------------------------------------------------
+# Hardened ingestion: video decode failures, UIEB quarantine
+# ----------------------------------------------------------------------
+
+
+def test_video_read_batch_skips_bad_frames_midstream():
+    cv2 = pytest.importorskip("cv2")
+    del cv2
+    from waternet_tpu.data.video import _read_batch
+
+    frames = [np.full((8, 8, 3), i, np.uint8) for i in range(10)]
+    cap = faults.FaultInjectingCapture(frames, bad_indices=(3, 4))
+    stats = {}
+    got = []
+    while True:
+        bgr, rgb = _read_batch(cap, 4, stats)
+        if rgb is None:
+            break
+        got.extend(int(f[0, 0, 0]) for f in bgr)
+    # Bad frames 3 and 4 skipped, order preserved, EOF still terminates.
+    assert got == [0, 1, 2, 5, 6, 7, 8, 9]
+    assert stats["decode_failures"] == 2
+    assert stats["frames_decoded"] == 8
+
+
+def test_video_read_batch_eof_unchanged():
+    pytest.importorskip("cv2")
+    from waternet_tpu.data.video import _read_batch
+
+    frames = [np.zeros((8, 8, 3), np.uint8)] * 3
+    cap = faults.FaultInjectingCapture(frames)
+    bgr, rgb = _read_batch(cap, 4, {})
+    assert len(bgr) == 3 and rgb.shape[0] == 4  # tail padded to batch size
+    assert _read_batch(cap, 4, {}) == ([], None)
+
+
+def test_video_stream_warns_with_totals():
+    pytest.importorskip("cv2")
+    from waternet_tpu.data.video import enhance_video_stream
+
+    class _Identity:
+        def enhance_async(self, rgb):
+            return rgb
+
+    frames = [np.full((8, 8, 3), i, np.uint8) for i in range(6)]
+    cap = faults.FaultInjectingCapture(frames, bad_indices=(2,))
+    stats = {}
+    with pytest.warns(RuntimeWarning, match="skipped 1 undecodable"):
+        out = list(enhance_video_stream(_Identity(), cap, batch_size=2,
+                                        stats=stats))
+    assert len(out) == 5
+    assert stats["decode_failures"] == 1
+
+
+def _write_png(path, value):
+    import cv2
+
+    cv2.imwrite(str(path), np.full((16, 16, 3), value, np.uint8))
+
+
+def test_uieb_quarantines_corrupt_pairs(tmp_path):
+    pytest.importorskip("cv2")
+    from waternet_tpu.data.uieb import CorruptPairError, UIEBDataset
+
+    raw, ref = tmp_path / "raw", tmp_path / "ref"
+    raw.mkdir(), ref.mkdir()
+    for i in range(4):
+        _write_png(raw / f"{i}.png", i)
+        _write_png(ref / f"{i}.png", i)
+    (raw / "2.png").write_bytes(b"\x89PNG not really a png")  # torn download
+
+    ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    with pytest.raises(CorruptPairError, match="2.png"):
+        ds.load_pair(2)
+    with pytest.warns(RuntimeWarning, match="quarantined 1/4.*2.png"):
+        clean = ds.prevalidate(np.arange(4))
+    assert list(clean) == [0, 1, 3]
+    assert ds.quarantined == ["2.png"]
+    # Clean pairs still load; batch composition over the clean set works.
+    batches = list(ds.batches(clean, 2, shuffle=False))
+    assert sum(b[0].shape[0] for b in batches) == 3
+
+
+def test_uieb_all_corrupt_is_hard_error(tmp_path):
+    pytest.importorskip("cv2")
+    from waternet_tpu.data.uieb import UIEBDataset
+
+    raw, ref = tmp_path / "raw", tmp_path / "ref"
+    raw.mkdir(), ref.mkdir()
+    _write_png(ref / "0.png", 0)
+    (raw / "0.png").write_bytes(b"garbage")
+    ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    with pytest.raises(ValueError, match="unusable"):
+        ds.prevalidate(np.arange(1))
+
+
+# ----------------------------------------------------------------------
+# Preemption -> checkpoint -> bit-identical resume (CLI end to end)
+# ----------------------------------------------------------------------
+
+
+def _assert_run_artifacts_identical(a: Path, b: Path):
+    assert (a / "metrics-train.csv").read_bytes() == (
+        b / "metrics-train.csv"
+    ).read_bytes()
+    assert (a / "metrics-val.csv").read_bytes() == (
+        b / "metrics-val.csv"
+    ).read_bytes()
+    wa, wb = np.load(a / "last.npz"), np.load(b / "last.npz")
+    assert sorted(wa.files) == sorted(wb.files)
+    assert all(np.array_equal(wa[k], wb[k]) for k in wa.files)
+
+
+@pytest.mark.parametrize("extra", [[], ["--device-cache"]],
+                         ids=["host-fed", "device-cache"])
+def test_sigterm_midepoch_resume_is_bit_identical(tmp_path, monkeypatch, extra):
+    full = _run_cli(tmp_path / "base", "full", extra + ["--epochs", "2"],
+                    monkeypatch)
+
+    work = tmp_path / "work"
+    faults.install(faults.FaultPlan.parse("sigterm@3"))
+    interrupted = _run_cli(work, "0", extra + ["--epochs", "2"], monkeypatch)
+    faults.clear()
+    # Preempted mid-epoch-2: a finalized checkpoint with the exact position.
+    cks = sorted((interrupted / "checkpoints").glob("step-*"))
+    meta = json.loads((cks[-1] / "_COMPLETE.json").read_text())
+    assert (meta["epoch"], meta["batch_index"]) == (1, 1)
+    assert len(meta["partial_metrics"]) == 1
+    assert not (interrupted / "metrics-train.csv").exists()  # died mid-run
+
+    resumed = _run_cli(
+        work, "1", extra + ["--epochs", "2", "--resume", "auto"], monkeypatch
+    )
+    _assert_run_artifacts_identical(full, resumed)
+
+
+def test_checkpoint_every_steps_writes_midepoch_checkpoints(
+    tmp_path, monkeypatch
+):
+    run = _run_cli(
+        tmp_path, "run", ["--epochs", "1", "--checkpoint-every", "1"],
+        monkeypatch,
+    )
+    cks = sorted((run / "checkpoints").glob("step-*"))
+    # 2 steps/epoch: one interval checkpoint after step 1, then the
+    # epoch-end checkpoint (same step id as the second interval save).
+    assert len(cks) >= 2
+    metas = [json.loads((c / "_COMPLETE.json").read_text()) for c in cks]
+    assert any(m["batch_index"] > 0 for m in metas)  # a true mid-epoch save
+
+
+def test_cli_nan_guard_completes_with_counters(tmp_path, monkeypatch):
+    faults.install(faults.FaultPlan.parse("nan@3"))
+    run = _run_cli(tmp_path, "run", ["--epochs", "2", "--nan-guard"],
+                   monkeypatch)
+    faults.clear()
+    train = np.loadtxt(run / "metrics-train.csv", delimiter=",", skiprows=1)
+    assert np.isfinite(train).all()
+    w = np.load(run / "last.npz")
+    assert all(np.isfinite(w[k]).all() for k in w.files)
+
+
+def test_resume_auto_falls_back_past_truncated_checkpoint(
+    tmp_path, monkeypatch
+):
+    work = tmp_path / "work"
+    _run_cli(work, "0", ["--epochs", "2"], monkeypatch)
+    victim = faults.largest_file(
+        work / "0" / "checkpoints" / "step-0000000004" / "state"
+    )
+    faults.truncate_file(victim, keep_bytes=16)
+
+    import jax
+
+    from waternet_tpu.resilience import auto_resume
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    eng = TrainingEngine(_tiny_config())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        meta = auto_resume(eng, work)
+    assert meta is not None and meta["step"] == 2
+    assert int(jax.device_get(eng.state.step)) == 2

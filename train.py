@@ -18,9 +18,18 @@ TPU-native additions:
   (`train.py:234` — a reference defect kept available for bug-compat);
 * ``--resume`` restores params + Adam moments + LR-schedule position from an
   Orbax checkpoint (the reference's resume silently reset both,
-  `train.py:243-245`).
+  `train.py:243-245`); ``--resume auto`` finds the newest restorable
+  checkpoint across run dirs, validating integrity and falling back past
+  half-written or corrupt ones (docs/RESILIENCE.md).
 * synthetic-data fallback: with no dataset on disk, ``--synthetic N`` trains
   on procedurally generated pairs (CI / bench environments).
+
+Fault tolerance (docs/RESILIENCE.md): SIGTERM/SIGINT checkpoint the run at
+the next step boundary with its exact dataloader position, so a preempted
+run resumes bit-for-bit; ``--checkpoint-every`` adds mid-epoch interval
+checkpoints; ``--keep-checkpoints`` bounds retention (last N + best val
+PSNR); ``--nan-guard`` contains non-finite steps by rollback + bounded
+batch-skip instead of corrupting the run.
 """
 
 from __future__ import annotations
@@ -55,12 +64,30 @@ def parse_args(argv=None):
     p.add_argument("--precache-vgg-ref", action="store_true", help="With --device-cache: also precompute the perceptual term's VGG features of every dihedral ref variant at cache-build time (the ref branch carries no gradient), removing ~8.6%% of step FLOPs (docs/MFU.md). Default off pending hardware A/B; numerics equivalent within compute-dtype tolerance")
     p.add_argument("--no-shuffle", action="store_true", help="Reference bug-compat: no train shuffling")
     p.add_argument("--no-augment", action="store_true", help="Disable flips/rot90 augmentation")
-    p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from, or 'auto' to pick up the latest run's state")
+    p.add_argument("--resume", type=str, help="Orbax checkpoint dir to resume from, or 'auto' to pick up the newest restorable checkpoint (validated; falls back past corrupt ones)")
+    p.add_argument("--checkpoint-every", type=str, metavar="N|Ns|Nm",
+                   help="Mid-epoch checkpoint cadence: a step count (e.g. 500), or seconds/minutes with an s/m suffix (e.g. 300s, 10m; single-host only — host clocks are not synchronized). Epoch-end checkpoints always happen")
+    p.add_argument("--keep-checkpoints", type=int, default=3, metavar="N",
+                   help="Retention: keep the newest N checkpoints plus the best-val-PSNR one (default 3)")
+    p.add_argument("--nan-guard", action="store_true",
+                   help="Divergence sentinel: verify step losses are finite (in windowed deferred fetches), roll back to the last-good snapshot and skip the offending batch on NaN/Inf, bounded per epoch")
     p.add_argument("--tensorboard", action="store_true", help="Write TensorBoard scalars to <rundir>/tb")
     p.add_argument("--synthetic", type=int, default=0, metavar="N", help="Train on N synthetic pairs instead of reading a dataset")
     p.add_argument("--profile-dir", type=str, help="Capture a jax.profiler trace of the first post-compilation epoch (epoch 2, or epoch 1 when --epochs 1) into this dir")
     p.add_argument("--debug-nans", action="store_true", help="Enable jax NaN checking (slower; for debugging diverging runs)")
     return p.parse_args(argv)
+
+
+def parse_checkpoint_interval(spec):
+    """``"500"`` -> (500 steps, 0 s); ``"300s"``/``"10m"`` -> (0, seconds)."""
+    if not spec:
+        return 0, 0.0
+    spec = spec.strip().lower()
+    if spec.endswith("s"):
+        return 0, float(spec[:-1])
+    if spec.endswith("m"):
+        return 0, float(spec[:-1]) * 60.0
+    return int(spec), 0.0
 
 
 def main(argv=None):
@@ -98,8 +125,29 @@ def main(argv=None):
         TrainConfig,
         TrainingEngine,
     )
+    from waternet_tpu.resilience import (
+        CheckpointManager,
+        DivergenceSentinel,
+        EpochControl,
+        Preempted,
+        PreemptionGuard,
+        auto_resume,
+    )
+    from waternet_tpu.resilience import faults as fault_plans
     from waternet_tpu.utils.checkpoint import save_weights
     from waternet_tpu.utils.rundir import next_run_dir
+
+    # Deterministic fault injection for resilience fire drills/tests
+    # (WATERNET_FAULTS="nan@3,sigterm@10"); no-op without the env var.
+    fault_plans.install_from_env()
+
+    every_steps, every_secs = parse_checkpoint_interval(args.checkpoint_every)
+    if every_secs and jax.process_count() > 1:
+        raise SystemExit(
+            "time-based --checkpoint-every is not multi-host safe (host "
+            "clocks differ, but the checkpoint save is a process "
+            "collective); use a step count"
+        )
 
     print(f"Devices: {jax.devices()}")
 
@@ -136,6 +184,24 @@ def main(argv=None):
             im_width=args.width,
         )
         train_idx, val_idx = reference_split(len(dataset), n_val=args.val_size)
+        # Decode-validate up front (the uint8 RAM cache pays this cost on
+        # the first epoch anyway): corrupt pairs are quarantined loudly and
+        # excluded BEFORE batch composition is fixed, instead of crashing
+        # the first epoch that touches them. Multi-host: every process must
+        # agree on the composition, so process 0's verdict is broadcast —
+        # a host whose local copy is corrupt anyway then fails loudly at
+        # load time instead of silently desynchronizing the collectives.
+        def _agreed(indices, clean):
+            if jax.process_count() == 1:
+                return clean
+            from jax.experimental import multihost_utils
+
+            mask = np.isin(np.asarray(indices), np.asarray(clean))
+            mask = np.asarray(multihost_utils.broadcast_one_to_all(mask))
+            return np.asarray(indices)[mask]
+
+        train_idx = _agreed(train_idx, dataset.prevalidate(train_idx))
+        val_idx = _agreed(val_idx, dataset.prevalidate(val_idx))
 
     # --- engine ---
     params = None
@@ -147,21 +213,38 @@ def main(argv=None):
             raise FileNotFoundError(f"could not load weights from {args.weights}")
     vgg_params = None if args.no_perceptual else resolve_vgg_params(args.vgg_weights)
     engine = TrainingEngine(config, params=params, vgg_params=vgg_params)
+    saved_train = {k: [] for k in TRAIN_METRICS_NAMES}
+    saved_val = {k: [] for k in VAL_METRICS_NAMES}
+    start_epoch = 0
+    start_batch = 0
+    carry = None
     if args.resume == "auto":
-        from waternet_tpu.utils.rundir import latest_run_dir
-
-        latest = latest_run_dir(projectroot / "training")
-        if latest is not None and (latest / "state").is_dir():
-            print(f"Auto-resuming from {latest / 'state'}")
-            engine.restore(latest / "state")
-        else:
+        resume_meta = auto_resume(engine, projectroot / "training")
+        if resume_meta is None:
             print("No previous run state found; starting fresh")
+        else:
+            # Managed checkpoints carry the exact dataloader position and
+            # metric history; legacy state/ dirs carry neither (meta {}),
+            # restoring only params + moments + schedule as before.
+            start_epoch = int(resume_meta.get("epoch", 0))
+            start_batch = int(resume_meta.get("batch_index", 0))
+            carry = resume_meta.get("partial_metrics") or None
+            for k, vals in (resume_meta.get("history_train") or {}).items():
+                saved_train[k] = list(vals)
+            for k, vals in (resume_meta.get("history_val") or {}).items():
+                saved_val[k] = list(vals)
+            if start_epoch or start_batch:
+                print(
+                    f"Resuming at epoch {start_epoch + 1}, "
+                    f"batch {start_batch}"
+                )
     elif args.resume:
         engine.restore(args.resume)
 
     savedir = next_run_dir(projectroot / "training")
-    saved_train = {k: [] for k in TRAIN_METRICS_NAMES}
-    saved_val = {k: [] for k in VAL_METRICS_NAMES}
+    manager = CheckpointManager(
+        savedir / "checkpoints", keep=args.keep_checkpoints
+    )
     throughputs = []
     tb_writer = None
     if args.tensorboard and jax.process_index() == 0:
@@ -181,77 +264,144 @@ def main(argv=None):
         # must fail loudly, not silently measure the wrong path.
         raise SystemExit("--precache-vgg-ref requires --device-cache")
 
+    def _midepoch_meta(epoch, next_batch, partial):
+        return {
+            "epoch": epoch,
+            "batch_index": next_batch,
+            "partial_metrics": partial,
+            "history_train": saved_train,
+            "history_val": saved_val,
+        }
+
+    guard = PreemptionGuard()
     profile_epoch = min(1, args.epochs - 1)  # first post-compilation epoch
-    for epoch in range(args.epochs):
-        if args.profile_dir and epoch == profile_epoch:
-            jax.profiler.start_trace(args.profile_dir)
-        t0 = time.perf_counter()
-        if args.device_cache:
-            train_metrics = engine.train_epoch_cached(epoch=epoch)
-        else:
-            train_metrics = engine.train_epoch(
-                dataset.batches(
-                    train_idx,
-                    config.batch_size,
-                    shuffle=config.shuffle,
-                    seed=config.seed,
-                    epoch=epoch,
+    with guard:
+        for epoch in range(start_epoch, args.epochs):
+            if args.profile_dir and epoch == profile_epoch:
+                jax.profiler.start_trace(args.profile_dir)
+            t0 = time.perf_counter()
+            sb = start_batch if epoch == start_epoch else 0
+            cy = carry if epoch == start_epoch else None
+            control = EpochControl(
+                preemption=guard,
+                sentinel=DivergenceSentinel() if args.nan_guard else None,
+                checkpoint_cb=lambda nb, pm, _e=epoch: manager.save(
+                    engine, meta=_midepoch_meta(_e, nb, pm)
                 ),
-                epoch=epoch,
+                every_steps=every_steps,
+                every_secs=every_secs,
             )
-        train_dt = time.perf_counter() - t0
-        if args.device_cache:
-            val_metrics = engine.eval_epoch_cached(
-                dataset=dataset, indices=val_idx
+            try:
+                if args.device_cache:
+                    train_metrics = engine.train_epoch_cached(
+                        epoch=epoch, start_batch=sb, control=control, carry=cy
+                    )
+                else:
+                    train_metrics = engine.train_epoch(
+                        dataset.batches(
+                            train_idx,
+                            config.batch_size,
+                            shuffle=config.shuffle,
+                            seed=config.seed,
+                            epoch=epoch,
+                            start=sb,
+                        ),
+                        epoch=epoch,
+                        start_batch=sb,
+                        start_items=min(
+                            sb * config.batch_size, len(train_idx)
+                        ),
+                        control=control,
+                        carry=cy,
+                    )
+            except Preempted as p:
+                manager.save(engine, meta=_midepoch_meta(epoch, p.next_batch, p.partial))
+                print(
+                    f"Preempted at epoch {epoch + 1}, batch {p.next_batch}; "
+                    "checkpoint saved. Resume with --resume auto."
+                )
+                return
+            train_dt = time.perf_counter() - t0
+            if args.device_cache:
+                val_metrics = engine.eval_epoch_cached(
+                    dataset=dataset, indices=val_idx
+                )
+            else:
+                val_metrics = engine.eval_epoch(
+                    dataset.batches(val_idx, config.batch_size, shuffle=False)
+                )
+            dt = time.perf_counter() - t0
+            if args.profile_dir and epoch == profile_epoch:
+                jax.profiler.stop_trace()
+
+            # Resumed partial epochs only trained the tail: report the
+            # throughput of the images actually processed, not the full
+            # epoch (summary.json feeds the BASELINE.json headline).
+            trained = len(train_idx) - min(sb * config.batch_size, len(train_idx))
+            ips = trained / train_dt
+            throughputs.append(ips)
+            print(
+                f"Epoch {epoch + 1}/{args.epochs} "
+                f"[train {train_dt:.1f}s + val {dt - train_dt:.1f}s, {ips:.1f} img/s]"
             )
-        else:
-            val_metrics = engine.eval_epoch(
-                dataset.batches(val_idx, config.batch_size, shuffle=False)
+            print(
+                "    Train ||",
+                "   ".join(f"{k}: {v:.03g}" for k, v in train_metrics.items()),
             )
-        dt = time.perf_counter() - t0
-        if args.profile_dir and epoch == profile_epoch:
-            jax.profiler.stop_trace()
+            print(
+                "    Val   ||",
+                "   ".join(f"{k}: {v:.03g}" for k, v in val_metrics.items()),
+            )
 
-        ips = len(train_idx) / train_dt
-        throughputs.append(ips)
-        print(
-            f"Epoch {epoch + 1}/{args.epochs} "
-            f"[train {train_dt:.1f}s + val {dt - train_dt:.1f}s, {ips:.1f} img/s]"
-        )
-        print(
-            "    Train ||",
-            "   ".join(f"{k}: {v:.03g}" for k, v in train_metrics.items()),
-        )
-        print(
-            "    Val   ||",
-            "   ".join(f"{k}: {v:.03g}" for k, v in val_metrics.items()),
-        )
+            # setdefault: --nan-guard adds sentinel counter keys beyond
+            # TRAIN_METRICS_NAMES; they're printed and checkpointed but kept
+            # out of the CSV columns.
+            for k, v in train_metrics.items():
+                saved_train.setdefault(k, []).append(v)
+            for k, v in val_metrics.items():
+                saved_val.setdefault(k, []).append(v)
 
-        for k, v in train_metrics.items():
-            saved_train[k].append(v)
-        for k, v in val_metrics.items():
-            saved_val[k].append(v)
+            if tb_writer is not None:
+                import tensorflow as tf
 
-        if tb_writer is not None:
-            import tensorflow as tf
+                with tb_writer.as_default(step=epoch):
+                    for k, v in train_metrics.items():
+                        tf.summary.scalar(f"train/{k}", v)
+                    for k, v in val_metrics.items():
+                        tf.summary.scalar(f"val/{k}", v)
+                    tf.summary.scalar("perf/images_per_sec", ips)
+                tb_writer.flush()  # don't lose the epoch on abnormal exit
 
-            with tb_writer.as_default(step=epoch):
-                for k, v in train_metrics.items():
-                    tf.summary.scalar(f"train/{k}", v)
-                for k, v in val_metrics.items():
-                    tf.summary.scalar(f"val/{k}", v)
-                tf.summary.scalar("perf/images_per_sec", ips)
-            tb_writer.flush()  # don't lose the epoch on abnormal exit
-
-        # Savedir created as late as possible (reference `train.py:303-306`).
-        # Multi-host: process 0 writes the npz; the Orbax checkpoint is a
-        # process-COLLECTIVE (it synchronizes all hosts internally) and must
-        # be called by every process or the others hang in the next
-        # all-reduce while 0 waits at the Orbax barrier.
-        savedir.mkdir(parents=True, exist_ok=True)
-        if jax.process_index() == 0:
-            save_weights(engine.state.params, savedir / "last.npz")
-        engine.checkpoint(savedir / "state")
+            # Savedir created as late as possible (reference `train.py:303-306`).
+            # Multi-host: process 0 writes the npz; the Orbax checkpoint is a
+            # process-COLLECTIVE (it synchronizes all hosts internally) and must
+            # be called by every process or the others hang in the next
+            # all-reduce while 0 waits at the Orbax barrier.
+            savedir.mkdir(parents=True, exist_ok=True)
+            if jax.process_index() == 0:
+                save_weights(engine.state.params, savedir / "last.npz")
+            engine.checkpoint(savedir / "state")
+            # Managed checkpoint: atomic finalize + marker, retention
+            # last-N + best-val-PSNR, and the position/history metadata a
+            # bit-exact --resume auto needs.
+            manager.save(
+                engine,
+                meta={
+                    "epoch": epoch + 1,
+                    "batch_index": 0,
+                    "history_train": saved_train,
+                    "history_val": saved_val,
+                    "val_psnr": float(val_metrics["psnr"]),
+                },
+            )
+            if guard.requested:
+                # Signal arrived during val/checkpointing: the epoch-end
+                # checkpoint above already captured everything.
+                print(
+                    f"Preempted after epoch {epoch + 1}; checkpoint saved. "
+                    "Resume with --resume auto."
+                )
+                return
 
     if jax.process_index() != 0:
         return

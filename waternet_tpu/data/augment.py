@@ -71,6 +71,22 @@ def augment_pair_np(rng, raw, ref):
     return raw, ref
 
 
+def advance_augment_rng(rng, n_items: int) -> None:
+    """Fast-forward a host augment stream past ``n_items`` images, dataless.
+
+    :func:`augment_pair_np` consumes the generator in a data-independent
+    pattern (per item: hflip draw, vflip draw, rotate draw, and — only when
+    the rotate draw hits — one ``integers(0, 4)``), so a mid-epoch resume
+    can advance the master stream past the already-trained prefix without
+    loading any images and reproduce the remaining draws bit-for-bit.
+    """
+    for _ in range(n_items):
+        rng.random()
+        rng.random()
+        if rng.random() < 0.5:
+            rng.integers(0, 4)
+
+
 def draw_augment(rng: jax.Array, n: int):
     """Per-image augmentation draws: (hflip, vflip, rotk).
 

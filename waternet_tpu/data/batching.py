@@ -27,11 +27,15 @@ def iter_batches(
     seed: int = 0,
     epoch: int = 0,
     drop_remainder: bool = False,
+    start: int = 0,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yield (raw_u8, ref_u8) NHWC uint8 batches for one epoch.
 
     Shuffle order is a deterministic function of (seed, epoch) via Philox, so
-    epochs are reproducible and resume replays the same order.
+    epochs are reproducible and resume replays the same order. ``start``
+    skips the first ``start`` batches WITHOUT loading them (mid-epoch
+    resume: the epoch's batch composition is unchanged, the iterator just
+    enters it at the recorded position).
     """
     if shuffle:
         order = epoch_permutation(indices, seed, epoch)
@@ -39,7 +43,7 @@ def iter_batches(
         order = np.array(indices, copy=True)
     n = len(order)
     stop = n - n % batch_size if drop_remainder else n
-    for start in range(0, stop, batch_size):
-        chunk = order[start : start + batch_size]
+    for start_i in range(start * batch_size, stop, batch_size):
+        chunk = order[start_i : start_i + batch_size]
         raws, refs = zip(*(load_pair(int(i)) for i in chunk))
         yield np.stack(raws), np.stack(refs)

@@ -42,6 +42,20 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 
+class CorruptPairError(RuntimeError):
+    """A pair's raw or reference PNG failed to decode after retries.
+
+    Carries the pair ``name`` and the offending ``path`` so ingestion-level
+    accounting (quarantine lists, warnings) can name the file, not just an
+    index.
+    """
+
+    def __init__(self, name: str, path):
+        super().__init__(f"could not decode {path} (pair {name!r})")
+        self.name = name
+        self.path = path
+
+
 class NonReferenceSplitWarning(RuntimeWarning):
     """The computed split does NOT match the reference's torch seed-0 split.
 
@@ -119,6 +133,8 @@ class UIEBDataset:
         self.im_height = im_height
         self.im_width = im_width
         self._cache: dict[int, Tuple[np.ndarray, np.ndarray]] = {} if cache else None
+        # Pair names whose PNGs failed to decode (see load_pair/prevalidate).
+        self.quarantined: list[str] = []
 
     def __len__(self) -> int:
         return len(self.names)
@@ -132,14 +148,38 @@ class UIEBDataset:
         h, w = shape[0], shape[1]
         return (w // 32) * 32, (h // 32) * 32
 
+    def _imread_retry(self, path, retries: int = 2):
+        """Decode with retries (transient I/O on network volumes); None on
+        persistent failure — cv2.imread's own contract for corrupt files."""
+        import cv2
+
+        for _ in range(1 + retries):
+            img = cv2.imread(str(path))
+            if img is not None:
+                return img
+        return None
+
     def load_pair(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
-        """-> (raw_rgb_u8, ref_rgb_u8), resized, cached."""
+        """-> (raw_rgb_u8, ref_rgb_u8), resized, cached.
+
+        Raises :class:`CorruptPairError` (and quarantines the pair name)
+        when either side fails to decode after retries — the reference
+        crashed with an opaque ``AttributeError: 'NoneType' object`` on the
+        first corrupt PNG. Use :meth:`prevalidate` to strip corrupt pairs
+        from an index set up front.
+        """
         if self._cache is not None and idx in self._cache:
             return self._cache[idx]
         import cv2
 
-        raw = cv2.imread(str(self.raw_dir / self.names[idx]))
-        ref = cv2.imread(str(self.ref_dir / self.names[idx]))
+        name = self.names[idx]
+        raw = self._imread_retry(self.raw_dir / name)
+        ref = self._imread_retry(self.ref_dir / name)
+        if raw is None or ref is None:
+            if name not in self.quarantined:
+                self.quarantined.append(name)
+            bad_path = (self.raw_dir if raw is None else self.ref_dir) / name
+            raise CorruptPairError(name, bad_path)
         tw, th = self._target_size(raw.shape)
         raw = cv2.resize(raw, (tw, th))
         ref = cv2.resize(ref, (tw, th))
@@ -149,6 +189,42 @@ class UIEBDataset:
         if self._cache is not None:
             self._cache[idx] = pair
         return pair
+
+    def prevalidate(self, indices) -> np.ndarray:
+        """Decode every pair in ``indices`` once; return the clean subset.
+
+        The dataset caches decoded uint8 anyway, so this only *moves* the
+        first epoch's decode cost to startup — in exchange, corrupt pairs
+        are excluded deterministically before batch composition is fixed
+        (mid-epoch skips would silently change batch shapes and the Philox
+        replay contract). Accounting is loud: a warning names every
+        quarantined pair, and an all-corrupt index set is a hard error.
+        """
+        import warnings
+
+        bad = []
+        for i in indices:
+            try:
+                self.load_pair(int(i))
+            except CorruptPairError as e:
+                bad.append((int(i), e.name))
+        if not bad:
+            return np.asarray(indices)
+        if len(bad) == len(indices):
+            raise ValueError(
+                f"all {len(bad)} pairs failed to decode — dataset unusable "
+                f"(first: {bad[0][1]!r})"
+            )
+        names = ", ".join(name for _, name in bad)
+        warnings.warn(
+            f"quarantined {len(bad)}/{len(indices)} corrupt pair(s): {names}. "
+            "They are excluded from this run; re-fetch the files to restore "
+            "them.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        bad_idx = {i for i, _ in bad}
+        return np.asarray([int(i) for i in indices if int(i) not in bad_idx])
 
     def batches(self, indices, batch_size: int, **kwargs) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         """Yield (raw_u8, ref_u8) NHWC uint8 batches for one epoch

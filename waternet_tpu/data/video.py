@@ -15,7 +15,7 @@ from typing import Iterator, Tuple
 import numpy as np
 
 
-def _read_batch(cap, batch_size: int):
+def _read_batch(cap, batch_size: int, stats: dict | None = None):
     """Read up to batch_size frames; returns (bgr_frames, rgb_array|None).
 
     A short final batch is padded (last frame repeated) up to batch_size so
@@ -23,15 +23,35 @@ def _read_batch(cap, batch_size: int):
     different shape would trigger a second multi-second XLA compile right at
     the end of every clip. ``bgr_frames`` keeps only the real frames; the
     caller drops the padded outputs by its length.
+
+    ``cap.read()`` returning False is ambiguous: end-of-stream OR a
+    mid-clip decode failure (bitstream corruption). The reference — and
+    this module's first version — treated both as EOF, silently truncating
+    the output video at the first bad frame. Disambiguate by progress: a
+    decode failure still *advances* ``CAP_PROP_POS_FRAMES`` (the container
+    grab succeeded, the codec retrieve failed), while EOF does not. Bad
+    frames are skipped and counted in ``stats['decode_failures']``; only a
+    stalled position ends the stream. Backends that don't track position
+    (live streams report 0/unchanging) degrade to the old EOF behavior.
     """
     import cv2
 
     frames = []
-    for _ in range(batch_size):
+    while len(frames) < batch_size:
+        before = cap.get(cv2.CAP_PROP_POS_FRAMES)
         ok, bgr = cap.read()
-        if not ok:
-            break
-        frames.append(bgr)
+        if ok and bgr is not None:
+            frames.append(bgr)
+            if stats is not None:
+                stats["frames_decoded"] = stats.get("frames_decoded", 0) + 1
+            continue
+        after = cap.get(cv2.CAP_PROP_POS_FRAMES)
+        if after > before:
+            # Forward progress without a frame: mid-stream decode failure.
+            if stats is not None:
+                stats["decode_failures"] = stats.get("decode_failures", 0) + 1
+            continue
+        break  # no progress: end of stream
     if not frames:
         return [], None
     rgb = np.stack([cv2.cvtColor(f, cv2.COLOR_BGR2RGB) for f in frames])
@@ -42,23 +62,44 @@ def _read_batch(cap, batch_size: int):
 
 
 def enhance_video_stream(
-    engine, cap, batch_size: int = 4
+    engine, cap, batch_size: int = 4, stats: dict | None = None
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yield (original_bgr, enhanced_bgr) frame pairs in order.
 
     ``engine`` is an :class:`waternet_tpu.inference_engine.InferenceEngine`;
-    ``cap`` is an opened cv2.VideoCapture.
+    ``cap`` is an opened cv2.VideoCapture. Undecodable mid-stream frames
+    are skipped (not treated as EOF — see :func:`_read_batch`); pass a
+    ``stats`` dict to receive the counts, and a summary warning is emitted
+    at end of stream whenever frames were dropped.
     """
     import cv2
 
-    prev_frames, prev_rgb = _read_batch(cap, batch_size)
+    if stats is None:
+        stats = {}
+
+    def _finish():
+        bad = stats.get("decode_failures", 0)
+        if bad:
+            import warnings
+
+            good = stats.get("frames_decoded", 0)
+            warnings.warn(
+                f"video: skipped {bad} undecodable frame(s) mid-stream "
+                f"({good} decoded). The clip is damaged; output omits the "
+                "bad frames instead of truncating at the first one.",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+
+    prev_frames, prev_rgb = _read_batch(cap, batch_size, stats)
     if prev_rgb is None:
+        _finish()
         return
     pending = engine.enhance_async(prev_rgb)
 
     while True:
         # Decode the next batch while the device works on `pending`.
-        next_frames, next_rgb = _read_batch(cap, batch_size)
+        next_frames, next_rgb = _read_batch(cap, batch_size, stats)
         from waternet_tpu.utils.tensor import ten2arr
 
         out = ten2arr(pending)  # sync point for the previous batch
@@ -67,5 +108,6 @@ def enhance_video_stream(
         for bgr_in, rgb_out in zip(prev_frames, out):
             yield bgr_in, cv2.cvtColor(rgb_out, cv2.COLOR_RGB2BGR)
         if next_rgb is None:
+            _finish()
             return
         prev_frames = next_frames

@@ -1,0 +1,40 @@
+"""Fault tolerance for long training runs.
+
+Production TPU fleets preempt VMs, feed corrupt inputs, and NaN out
+multi-day runs; at the reference's 400-epoch horizon (PAPER.md) losing a
+run to any of those is the dominant failure mode. This package holds the
+pieces train.py wires through the trainer, checkpoint layer, and data
+pipelines:
+
+* :mod:`preemption` — SIGTERM/SIGINT -> checkpoint-at-next-step-boundary
+  (:class:`PreemptionGuard`, :class:`Preempted`);
+* :mod:`manager` — atomic, marker-finalized checkpoints with retention and
+  validated ``--resume auto`` fallback (:class:`CheckpointManager`,
+  :func:`auto_resume`);
+* :mod:`sentinel` — non-finite loss detection with rollback to a last-good
+  snapshot and bounded batch-skip (:class:`DivergenceSentinel`);
+* :mod:`control` — the per-epoch bundle the trainer's epoch driver consults
+  at step boundaries (:class:`EpochControl`);
+* :mod:`faults` — the deterministic fault-injection harness the resilience
+  tests drive (env var ``WATERNET_FAULTS`` or programmatic plans).
+
+Everything here is multi-host-aware: checkpoint saves stay process-collective
+(each process calls them; process 0 alone touches the filesystem markers),
+and rollback/skip decisions are pure functions of replicated metric values,
+so every process takes the same branch. See docs/RESILIENCE.md.
+"""
+
+from waternet_tpu.resilience.control import EpochControl
+from waternet_tpu.resilience.manager import CheckpointManager, auto_resume
+from waternet_tpu.resilience.preemption import Preempted, PreemptionGuard
+from waternet_tpu.resilience.sentinel import DivergenceError, DivergenceSentinel
+
+__all__ = [
+    "CheckpointManager",
+    "DivergenceError",
+    "DivergenceSentinel",
+    "EpochControl",
+    "Preempted",
+    "PreemptionGuard",
+    "auto_resume",
+]

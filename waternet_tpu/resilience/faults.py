@@ -1,0 +1,196 @@
+"""Deterministic fault injection for the resilience test suite.
+
+Real preemptions, NaN steps, and corrupt files are rare and nondeterministic;
+this harness makes each one a reproducible event so tests (and operators
+doing fire drills) can assert exact recovery behavior. A :class:`FaultPlan`
+is a set of one-shot events, each keyed by a deterministic counter:
+
+* ``nan@K`` — after the engine dispatches global step K (1-based, counted on
+  the host), poison the train state's float params with NaN and report a
+  NaN loss for that step: the faithful signature of a non-finite gradient.
+* ``sigterm@K`` — deliver a real SIGTERM to this process after global step
+  K, exercising the actual signal path of
+  :class:`waternet_tpu.resilience.preemption.PreemptionGuard`.
+* ``truncate_ckpt@K`` — after the K-th (1-based) finalized checkpoint save,
+  truncate its largest payload file, simulating a mid-write crash or torn
+  volume that the marker protocol alone cannot see.
+
+Plans come from the environment (``WATERNET_FAULTS="nan@3,sigterm@10"``,
+read once by :func:`install_from_env`, which train.py calls) or from tests
+via :func:`install`. With no plan installed every hook is a single ``is
+None`` check — zero overhead on the hot path. Events are one-shot: a replay
+of the same batch after a sentinel rollback does NOT re-fire the fault
+(matching reality, where the skip removes the offending batch).
+
+File-corruption helpers (:func:`truncate_file`,
+:class:`FaultInjectingCapture`) are exported for tests that corrupt PNGs
+and video streams directly.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from pathlib import Path
+
+_PLAN: "FaultPlan | None" = None
+
+
+class FaultPlan:
+    """One-shot fault events keyed by (kind, ordinal)."""
+
+    KINDS = ("nan", "sigterm", "truncate_ckpt")
+
+    def __init__(self, events=()):
+        self._pending = set()
+        for kind, at in events:
+            if kind not in self.KINDS:
+                raise ValueError(f"unknown fault kind {kind!r} (have {self.KINDS})")
+            self._pending.add((kind, int(at)))
+        self.fired: list = []
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``"nan@3,sigterm@10"`` -> plan. Whitespace tolerated."""
+        events = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            kind, _, at = part.partition("@")
+            if not at:
+                raise ValueError(f"fault {part!r} needs '@<step>'")
+            events.append((kind.strip(), int(at)))
+        return cls(events)
+
+    def fire(self, kind: str, at: int) -> bool:
+        """Consume the (kind, at) event if armed. One-shot."""
+        key = (kind, int(at))
+        if key in self._pending:
+            self._pending.remove(key)
+            self.fired.append(key)
+            return True
+        return False
+
+    def __bool__(self):
+        return bool(self._pending)
+
+
+def install(plan: FaultPlan | None) -> None:
+    global _PLAN
+    _PLAN = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> FaultPlan | None:
+    return _PLAN
+
+
+def install_from_env(env: str = "WATERNET_FAULTS") -> FaultPlan | None:
+    spec = os.environ.get(env)
+    if spec:
+        install(FaultPlan.parse(spec))
+    return _PLAN
+
+
+# ----------------------------------------------------------------------
+# Hooks — called from the trainer / checkpoint manager hot paths.
+# ----------------------------------------------------------------------
+
+
+def after_train_step(engine, metrics, global_step: int):
+    """Hook run after each dispatched train step.
+
+    Returns the (possibly poisoned) per-step metrics mapping. ``nan`` events
+    poison the live train state's float params and override the step's
+    metrics with NaN — exactly what a non-finite gradient does to Adam.
+    """
+    if _PLAN is None:
+        return metrics
+    if _PLAN.fire("nan", global_step):
+        import jax.numpy as jnp
+        import numpy as np
+
+        def _poison(x):
+            return x * np.float32("nan") if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        import jax
+
+        engine.state = engine.state.replace(
+            params=jax.tree.map(_poison, engine.state.params)
+        )
+        metrics = {k: float("nan") for k in metrics}
+    if _PLAN.fire("sigterm", global_step):
+        os.kill(os.getpid(), signal.SIGTERM)
+    return metrics
+
+
+def after_checkpoint_save(path, ordinal: int) -> None:
+    """Hook run (process 0 only) after the ``ordinal``-th finalized save."""
+    if _PLAN is None:
+        return
+    if _PLAN.fire("truncate_ckpt", ordinal):
+        victim = largest_file(path)
+        if victim is not None:
+            truncate_file(victim, keep_bytes=max(1, victim.stat().st_size // 3))
+
+
+# ----------------------------------------------------------------------
+# File / stream corruption helpers for tests.
+# ----------------------------------------------------------------------
+
+
+def largest_file(root) -> Path | None:
+    files = [p for p in Path(root).rglob("*") if p.is_file()]
+    return max(files, key=lambda p: p.stat().st_size, default=None)
+
+
+def truncate_file(path, keep_bytes: int = 16) -> Path:
+    """Truncate ``path`` in place to ``keep_bytes`` (simulated torn write)."""
+    path = Path(path)
+    data = path.read_bytes()[:keep_bytes]
+    path.write_bytes(data)
+    return path
+
+
+class FaultInjectingCapture:
+    """cv2.VideoCapture look-alike that fails decode at chosen frame indices.
+
+    Mimics the backend contract :func:`waternet_tpu.data.video._read_batch`
+    relies on: a mid-stream decode failure still *advances*
+    ``CAP_PROP_POS_FRAMES`` (grab succeeded, retrieve failed) while EOF does
+    not. Wraps either a real capture or a list of frames.
+    """
+
+    def __init__(self, frames, bad_indices=(), frame_count=None):
+        self._frames = list(frames)
+        self._bad = set(int(i) for i in bad_indices)
+        self._pos = 0
+        self._count = len(self._frames) if frame_count is None else frame_count
+
+    def read(self):
+        if self._pos >= len(self._frames):
+            return False, None
+        i = self._pos
+        self._pos += 1  # grab advances even when retrieve (decode) fails
+        if i in self._bad:
+            return False, None
+        return True, self._frames[i]
+
+    def grab(self):
+        if self._pos >= len(self._frames):
+            return False
+        self._pos += 1
+        return True
+
+    def get(self, prop):
+        import cv2
+
+        if prop == cv2.CAP_PROP_POS_FRAMES:
+            return float(self._pos)
+        if prop == cv2.CAP_PROP_FRAME_COUNT:
+            return float(self._count)
+        return 0.0

@@ -1,0 +1,192 @@
+"""Checkpoint manager: atomic finalize, retention, validated auto-resume.
+
+Layout under a run dir (``training/<n>/checkpoints/``)::
+
+    step-0000000042/
+        state/            Orbax tree (params + Adam moments + step)
+        _COMPLETE.json    marker, written LAST; holds the resume metadata
+
+The marker is the finalize: a checkpoint without it is, by construction,
+half-written (the directory itself appears atomically via tmp +
+``os.replace`` in :func:`waternet_tpu.utils.checkpoint.save_state_atomic`,
+and the marker lands only after that rename). Readers therefore never need
+to guess — :meth:`CheckpointManager.restore_latest_good` walks checkpoints
+newest-first, skips unmarked ones, *test-restores* marked ones, and falls
+back to the previous checkpoint when restore fails (truncated payloads,
+torn volumes — the cases a marker alone can't catch).
+
+Resume metadata records the exact dataloader position ``(epoch,
+batch_index)`` plus the per-step metrics of the partial epoch and the
+completed-epoch history, so a resumed run reproduces the uninterrupted
+run's CSV artifacts bit-for-bit (batch composition is a pure function of
+``(seed, epoch)`` via the shared Philox stream).
+
+Retention keeps the last ``keep`` checkpoints by step plus the single best
+by validation PSNR — the one you'd actually ship if the run dies for good.
+
+Multi-host: ``save`` must be called by every process (the Orbax save inside
+is collective); markers, pruning, and fault hooks run on process 0 only.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+from typing import NamedTuple, Optional
+
+MARKER = "_COMPLETE.json"
+
+
+class Checkpoint(NamedTuple):
+    path: Path  # the step-* directory
+    step: int
+    meta: dict
+
+    @property
+    def state_dir(self) -> Path:
+        return self.path / "state"
+
+
+class CheckpointManager:
+    def __init__(self, root, keep: int = 3):
+        self.root = Path(root)
+        self.keep = max(1, int(keep))
+        self._saves = 0  # ordinal for the fault-injection hook
+
+    # ------------------------------------------------------------------
+    # Write path
+    # ------------------------------------------------------------------
+
+    def save(self, engine, meta: Optional[dict] = None) -> Path:
+        """Atomic checkpoint of ``engine``'s full train state + metadata."""
+        import jax
+
+        from waternet_tpu.resilience import faults
+        from waternet_tpu.utils.checkpoint import save_state_atomic
+
+        meta = dict(meta or {})
+        step = int(meta.get("step", getattr(engine, "_host_step", 0)))
+        meta["step"] = step
+        final = self.root / f"step-{step:010d}"
+        # Orbax saves into a tmp sibling; the whole step dir then appears
+        # atomically, and the marker is written strictly after.
+        tmp = self.root / f".tmp-step-{step:010d}"
+        if jax.process_index() == 0:
+            if tmp.exists():
+                shutil.rmtree(tmp)
+            if final.exists():  # re-save of the same step (epoch end after
+                shutil.rmtree(final)  # an interval save): replace it
+        save_state_atomic(jax.device_get(engine.state), tmp / "state")
+        self._saves += 1
+        if jax.process_index() == 0:
+            import os
+
+            os.replace(tmp, final)
+            (final / MARKER).write_text(json.dumps(meta, indent=2))
+            faults.after_checkpoint_save(final, self._saves)
+            self.prune()
+        return final
+
+    def prune(self) -> None:
+        """Keep the newest ``keep`` checkpoints + the best-val-PSNR one."""
+        cks = self.checkpoints()
+        if len(cks) <= self.keep:
+            return
+        keep = set(ck.path for ck in cks[-self.keep :])
+        scored = [ck for ck in cks if ck.meta.get("val_psnr") is not None]
+        if scored:
+            best = max(scored, key=lambda ck: ck.meta["val_psnr"])
+            keep.add(best.path)
+        for ck in cks:
+            if ck.path not in keep:
+                shutil.rmtree(ck.path, ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # Read path
+    # ------------------------------------------------------------------
+
+    def checkpoints(self) -> list:
+        """Complete (marker-finalized) checkpoints, ascending by step."""
+        out = []
+        if not self.root.is_dir():
+            return out
+        for p in sorted(self.root.glob("step-*")):
+            marker = p / MARKER
+            if not (p.is_dir() and marker.is_file()):
+                continue
+            try:
+                meta = json.loads(marker.read_text())
+            except (OSError, json.JSONDecodeError):
+                continue
+            out.append(Checkpoint(p, int(meta.get("step", -1)), meta))
+        out.sort(key=lambda ck: ck.step)
+        return out
+
+    def restore_latest_good(self, engine) -> Optional[Checkpoint]:
+        """Restore the newest checkpoint that actually loads.
+
+        Integrity validation IS a restore attempt: a truncated or corrupt
+        checkpoint raises inside ``engine.restore`` and we fall back to the
+        previous one instead of crashing, warning loudly about each reject.
+        A model-config MISMATCH is not corruption: every checkpoint of the
+        run would fail identically and the fallback would silently retrain
+        from scratch, so it propagates (with the shape report) instead.
+        """
+        import warnings
+
+        from waternet_tpu.training.trainer import CheckpointMismatchError
+
+        for ck in reversed(self.checkpoints()):
+            try:
+                engine.restore(ck.state_dir)
+                return ck
+            except CheckpointMismatchError:
+                raise
+            except Exception as e:  # corrupt/truncated: fall back
+                warnings.warn(
+                    f"checkpoint {ck.path.name} failed to restore "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous checkpoint",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        return None
+
+
+def auto_resume(engine, training_base) -> Optional[dict]:
+    """``--resume auto``: restore the newest good state across run dirs.
+
+    Walks run dirs newest-first. Per run: managed checkpoints first (with
+    corrupt-checkpoint fallback), then the legacy per-epoch ``state/`` dir.
+    Returns the resume metadata dict (``{}`` for legacy states, which carry
+    no position — training restarts its epoch loop with restored params,
+    moments, and schedule), or ``None`` for a fresh start.
+    """
+    import warnings
+
+    from waternet_tpu.training.trainer import CheckpointMismatchError
+    from waternet_tpu.utils.rundir import run_dirs_desc
+
+    for run in run_dirs_desc(training_base):
+        mgr = CheckpointManager(run / "checkpoints")
+        ck = mgr.restore_latest_good(engine)
+        if ck is not None:
+            print(f"Auto-resuming from {ck.path}")
+            return ck.meta
+        legacy = run / "state"
+        if legacy.is_dir():
+            try:
+                engine.restore(legacy)
+                print(f"Auto-resuming from legacy checkpoint {legacy}")
+                return {}
+            except CheckpointMismatchError:
+                raise
+            except Exception as e:
+                warnings.warn(
+                    f"legacy checkpoint {legacy} failed to restore "
+                    f"({type(e).__name__}: {e}); trying earlier runs",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+    return None

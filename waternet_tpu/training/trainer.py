@@ -95,6 +95,47 @@ def _cache_token(obj) -> int:
     return tok
 
 
+class CheckpointMismatchError(ValueError):
+    """Checkpoint loads fine but does not fit this engine's model config.
+
+    Distinct from I/O-level corruption so ``--resume auto`` can tell the
+    two apart: corruption falls back to the previous checkpoint; a config
+    mismatch aborts with the shape report (falling back would silently
+    retrain from scratch — every checkpoint would "fail" identically).
+    """
+
+
+def _param_shapes(tree) -> dict:
+    """Flat ``{"a/b/c": shape}`` view of a nested param pytree."""
+    import numpy as np
+
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(
+            str(p.key) if hasattr(p, "key") else str(getattr(p, "idx", p))
+            for p in path
+        )
+        flat[key] = tuple(np.shape(leaf))
+    return flat
+
+
+def _params_mismatch_report(ckpt_params, model_params) -> str:
+    """Human-readable diff of two param trees; empty string when they fit."""
+    ck, mo = _param_shapes(ckpt_params), _param_shapes(model_params)
+    lines = []
+    for key in sorted(set(ck) | set(mo)):
+        if key not in ck:
+            lines.append(f"  missing from checkpoint: {key} (model {mo[key]})")
+        elif key not in mo:
+            lines.append(f"  not in model: {key} (checkpoint {ck[key]})")
+        elif ck[key] != mo[key]:
+            lines.append(
+                f"  shape mismatch at {key}: checkpoint {ck[key]} "
+                f"vs model {mo[key]}"
+            )
+    return "\n".join(lines)
+
+
 @dataclasses.dataclass
 class TrainConfig:
     epochs: int = 400
@@ -197,11 +238,19 @@ class TrainingEngine:
         self.vgg_params = (
             jax.device_put(vgg_params, rep) if vgg_params is not None else None
         )
-        self.state = TrainStateT(
-            params=jax.device_put(params, rep),
-            opt_state=jax.device_put(self.optimizer.init(params), rep),
-            step=jnp.zeros((), jnp.int32),
+        # _own_device_state (not bare device_put): params may be host numpy
+        # (npz weights), and the first train step DONATES the state — see
+        # the helper's docstring for the aliasing hazard.
+        self.state = self._own_device_state(
+            TrainStateT(
+                params=params,
+                opt_state=self.optimizer.init(params),
+                step=jnp.zeros((), jnp.int32),
+            )
         )
+        # Host mirror of state.step: checkpoint cadence and fault-injection
+        # keys need the global step every batch without a device sync.
+        self._host_step = 0
         self._compile_steps()
 
     # ------------------------------------------------------------------
@@ -844,9 +893,12 @@ class TrainingEngine:
             )
         return self.train_step_cached, (self._cache_raw, self._cache_ref)
 
-    def train_epoch_cached(self, epoch: int) -> dict:
+    def train_epoch_cached(
+        self, epoch: int, *, start_batch: int = 0, control=None, carry=None
+    ) -> dict:
         """One epoch over the cached dataset; same metric contract as
-        :meth:`train_epoch`. Requires :meth:`cache_dataset` first."""
+        :meth:`train_epoch`. Requires :meth:`cache_dataset` first.
+        ``start_batch``/``control``/``carry`` as in :meth:`train_epoch`."""
         if getattr(self, "_cache_raw", None) is None:
             raise RuntimeError("call cache_dataset() before train_epoch_cached()")
         if self.config.host_preprocess:
@@ -854,26 +906,29 @@ class TrainingEngine:
                 "device cache requires device preprocessing "
                 "(host_preprocess=False)"
             )
-        sums = {k: 0.0 for k in TRAIN_METRICS_NAMES}
-        count = 0
         base_rng = jax.random.PRNGKey(self.config.seed + 1)
-        pending = []
         n = self._cache_raw.shape[0]
-        for idx, n_real in self._cached_index_batches(
-            n, epoch, self.config.shuffle
-        ):
+
+        def payloads():
+            batches = self._cached_index_batches(n, epoch, self.config.shuffle)
+            for count, (idx, n_real) in enumerate(batches):
+                if count < start_batch:
+                    continue
+                yield count, (idx, n_real)
+
+        def dispatch(count, payload):
+            idx, n_real = payload
             rng = jax.random.fold_in(jax.random.fold_in(base_rng, epoch), count)
             step_fn, cache_args = self.cached_train_step()
             self.state, metrics = step_fn(
                 self.state, *cache_args, self._replicate_global(idx), rng,
                 n_real,
             )
-            pending.append(metrics)
-            count += 1
-        for metrics in pending:
-            for k in sums:
-                sums[k] += float(metrics[k])
-        return {k: v / max(count, 1) for k, v in sums.items()}
+            return self._post_step(metrics)
+
+        return self._drive_train_epoch(
+            payloads(), dispatch, control=control, carry=carry
+        )
 
     def eval_epoch_cached(self, dataset=None, indices=None) -> dict:
         """Eval over a device-resident cache. With dataset/indices given,
@@ -926,37 +981,190 @@ class TrainingEngine:
     # Epoch drivers
     # ------------------------------------------------------------------
 
-    def train_epoch(self, batch_iter, epoch: int) -> dict:
+    def train_epoch(
+        self,
+        batch_iter,
+        epoch: int,
+        *,
+        start_batch: int = 0,
+        start_items: Optional[int] = None,
+        control=None,
+        carry=None,
+    ) -> dict:
         """Runs one epoch; returns reference-style epoch-mean metrics
-        (equal-weighted over minibatches, `/root/reference/train.py:151`)."""
+        (equal-weighted over minibatches, `/root/reference/train.py:151`).
+
+        Mid-epoch resume: ``batch_iter`` yields the batches from position
+        ``start_batch`` on (the caller passes ``start=`` to the dataset
+        iterator), ``carry`` holds the per-step metric dicts of the
+        already-trained prefix so the epoch means stay bit-identical to an
+        uninterrupted run, and ``start_items`` (host-preprocess only) is the
+        item count of the skipped prefix, used to fast-forward the host
+        augment stream. ``control`` is an
+        :class:`waternet_tpu.resilience.EpochControl` consulted at step
+        boundaries for preemption, divergence rollback, and interval
+        checkpoints; None (the default) keeps the plain deferred-fetch loop.
+        """
+        import copy
+
         import numpy as np
 
-        sums = {k: 0.0 for k in TRAIN_METRICS_NAMES}
-        count = 0
         base_rng = jax.random.PRNGKey(self.config.seed + 1)
         host_rng = np.random.default_rng(self.config.seed + 7 + epoch)
-        pending = []
-        for raw, ref in batch_iter:
-            raw, ref, n_real = self._pad_batch(raw, ref)
+        if start_batch and self.config.host_preprocess and self.config.augment:
+            from waternet_tpu.data.augment import advance_augment_rng
+            from waternet_tpu.parallel.mesh import DATA_AXIS
+
+            # Mirror the EXACT draw consumption of the skipped prefix:
+            # augmentation runs over the PADDED batch (_pad_batch rounds
+            # each batch up to a data-axis multiple, and the padded rows
+            # consume draws too), so advance by each skipped batch's padded
+            # row count, not its item count.
+            n_data = self.mesh.shape[DATA_AXIS]
+            b = self.config.batch_size
+            total = start_batch * b if start_items is None else start_items
+            for k in range(start_batch):
+                n_real = min(b, total - k * b)
+                if n_real <= 0:
+                    break
+                advance_augment_rng(host_rng, -(-n_real // n_data) * n_data)
+
+        def payloads():
+            for count, (raw, ref) in enumerate(batch_iter, start=start_batch):
+                raw_p, ref_p, n_real = self._pad_batch(raw, ref)
+                yield count, {
+                    "raw": raw_p, "ref": ref_p, "n_real": n_real,
+                    "aug_state": None,
+                }
+
+        def dispatch(count, payload):
             if self.config.host_preprocess:
-                tensors = self._host_preprocess_batch(raw, ref, host_rng)
+                rng_np = None
+                if self.config.augment:
+                    if payload["aug_state"] is None:
+                        # First dispatch: record the master stream position
+                        # (a sentinel replay clones it to reproduce the
+                        # exact augment draws) and consume the master.
+                        payload["aug_state"] = copy.deepcopy(
+                            host_rng.bit_generator.state
+                        )
+                        rng_np = host_rng
+                    else:
+                        rng_np = np.random.default_rng(0)
+                        rng_np.bit_generator.state = copy.deepcopy(
+                            payload["aug_state"]
+                        )
+                tensors = self._host_preprocess_batch(
+                    payload["raw"], payload["ref"], rng_np
+                )
                 self.state, metrics = self.train_step_pre(
-                    self.state, *tensors, n_real
+                    self.state, *tensors, payload["n_real"]
                 )
             else:
                 rng = jax.random.fold_in(
                     jax.random.fold_in(base_rng, epoch), count
                 )
                 self.state, metrics = self.train_step(
-                    self.state, self._to_global(raw), self._to_global(ref),
-                    rng, n_real,
+                    self.state,
+                    self._to_global(payload["raw"]),
+                    self._to_global(payload["ref"]),
+                    rng,
+                    payload["n_real"],
                 )
-            pending.append(metrics)
-            count += 1
-        for metrics in pending:  # fetch after the epoch; no per-step syncs
+            return self._post_step(metrics)
+
+        return self._drive_train_epoch(
+            payloads(), dispatch, control=control, carry=carry
+        )
+
+    def _post_step(self, metrics):
+        """Host bookkeeping after each dispatched step: advance the host
+        step mirror and run the fault-injection hook (an ``is None`` check
+        when no plan is installed)."""
+        self._host_step += 1
+        from waternet_tpu.resilience import faults
+
+        return faults.after_train_step(self, metrics, self._host_step)
+
+    def _drive_train_epoch(self, payloads, dispatch, control=None, carry=None):
+        """Shared train-epoch driver: deferred metric fetch + resilience.
+
+        ``payloads`` yields ``(count, payload)`` with ``count`` the absolute
+        batch index within the epoch; ``dispatch(count, payload)`` runs ONE
+        step (updating ``self.state``) and returns its per-step metrics.
+        Dispatch must be re-invokable with the same arguments and reproduce
+        the step bit-for-bit — that determinism is what makes the
+        sentinel's rollback-replay and mid-epoch resume exact.
+
+        With ``control=None`` this is exactly the historical loop: dispatch
+        everything, fetch the metric scalars once at epoch end. A sentinel
+        shortens the fetch horizon to its window; preemption and interval
+        checkpoints drain at the boundary they fire on.
+        """
+        fetched = [dict(m) for m in carry] if carry else []
+        pending = []  # [(count, payload, device metrics)]
+        sentinel = control.sentinel if control is not None else None
+        snapshot = None
+        if sentinel is not None:
+            sentinel.begin_epoch()
+            snapshot = self._host_state_copy()
+        if control is not None:
+            from waternet_tpu.resilience.preemption import Preempted
+
+        def _floats(m):
+            return {k: float(v) for k, v in m.items()}
+
+        def verify():
+            """Fetch pending metrics; under a sentinel, contain NaN steps.
+
+            On the first non-finite value: restore the last verified
+            snapshot, replay the verified-good prefix (bit-identical — the
+            batches, rng folds, and augment draws are pure functions of
+            (seed, epoch, batch index)), drop the offending batch, re-run
+            the tail in the clean timeline, and loop to re-verify it. Each
+            pass removes one batch, so this terminates; the sentinel's skip
+            budget bounds it long before that.
+            """
+            nonlocal pending, snapshot
+            while pending:
+                vals = [_floats(m) for _, _, m in pending]
+                bad = sentinel.first_bad(vals) if sentinel is not None else None
+                if bad is None:
+                    fetched.extend(vals)
+                    pending = []
+                    break
+                sentinel.note_skip(pending[bad][0])
+                self.state = self._own_device_state(snapshot)
+                replay = pending[:bad] + pending[bad + 1 :]
+                pending = []
+                for cnt, payload, _ in replay:
+                    pending.append((cnt, payload, dispatch(cnt, payload)))
+            if sentinel is not None:
+                snapshot = self._host_state_copy()
+
+        for count, payload in payloads:
+            pending.append((count, payload, dispatch(count, payload)))
+            if control is None:
+                continue
+            if sentinel is not None and len(pending) >= sentinel.window:
+                verify()
+            if control.preempt_requested():
+                verify()
+                raise Preempted(count + 1, fetched)
+            if control.checkpoint_due():
+                verify()
+                control.checkpoint(count + 1, fetched)
+        verify()  # fetch after the epoch; no per-step syncs
+        sums = {k: 0.0 for k in TRAIN_METRICS_NAMES}
+        for m in fetched:
             for k in sums:
-                sums[k] += float(metrics[k])
-        return {k: v / max(count, 1) for k, v in sums.items()}
+                sums[k] += m[k]
+        n = len(fetched)
+        out = {k: v / max(n, 1) for k, v in sums.items()}
+        if sentinel is not None:
+            out["nan_skipped"] = float(sentinel.skipped)
+            out["nan_rollbacks"] = float(sentinel.rollbacks)
+        return out
 
     def eval_epoch(self, batch_iter) -> dict:
         sums = {k: 0.0 for k in VAL_METRICS_NAMES}
@@ -986,16 +1194,21 @@ class TrainingEngine:
 
     def checkpoint(self, path) -> None:
         """Save full train state with Orbax (reference saved weights only,
-        resetting optimizer + LR schedule on resume — `train.py:243-245,308`)."""
-        from pathlib import Path
+        resetting optimizer + LR schedule on resume — `train.py:243-245,308`).
+        Atomic: the final path appears via tmp + ``os.replace``, so a crash
+        mid-save never leaves a half-written state dir at ``path``."""
+        from waternet_tpu.utils.checkpoint import save_state_atomic
 
-        import orbax.checkpoint as ocp
-
-        path = Path(path).absolute()
-        ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(path, jax.device_get(self.state), force=True)
+        save_state_atomic(jax.device_get(self.state), path)
 
     def restore(self, path) -> None:
+        """Restore full train state, with a clear error on config mismatch.
+
+        When the checkpoint's param tree doesn't fit this engine's model
+        (different architecture or precision config), the failure names the
+        mismatched param paths and shapes instead of surfacing a cryptic
+        Orbax/tensorstore tree error.
+        """
         from pathlib import Path
 
         import orbax.checkpoint as ocp
@@ -1003,13 +1216,74 @@ class TrainingEngine:
         path = Path(path).absolute()
         ckptr = ocp.PyTreeCheckpointer()
         template = jax.device_get(self.state)
-        restored = ckptr.restore(path, item=template)
-        rep = replicated(self.mesh)
-        self.state = jax.device_put(
+        try:
+            restored = ckptr.restore(path, item=template)
+        except Exception as err:
+            report = None
+            try:
+                # Structure mismatch: re-read in the checkpoint's own
+                # structure and diff the param trees for the message.
+                raw = ckptr.restore(path)
+                report = _params_mismatch_report(
+                    raw.get("params", {}), template.params
+                )
+            except Exception:
+                pass  # unreadable (truncated/corrupt): surface the original
+            if report:
+                raise CheckpointMismatchError(
+                    f"checkpoint at {path} does not fit the model config:\n"
+                    f"{report}"
+                ) from err
+            raise
+        # Orbax restores saved array shapes regardless of the template, so a
+        # same-structure checkpoint with different shapes loads "fine" and
+        # would only blow up steps later inside the jitted step. Catch it
+        # here, by name.
+        report = _params_mismatch_report(restored.params, template.params)
+        if report:
+            raise CheckpointMismatchError(
+                f"checkpoint at {path} does not fit the model config:\n"
+                f"{report}"
+            )
+        self.state = self._own_device_state(
             TrainStateT(
                 params=restored.params,
                 opt_state=restored.opt_state,
                 step=jnp.asarray(restored.step),
-            ),
-            rep,
+            )
+        )
+        self._host_step = int(jax.device_get(self.state.step))
+
+    def _own_device_state(self, host_state):
+        """Host state pytree -> device state with XLA-OWNED buffers.
+
+        ``jax.device_put`` on CPU zero-copies aligned numpy arrays, so a
+        state built from host arrays (an Orbax restore, a rollback
+        snapshot) merely *borrows* its memory. The next train step then
+        DONATES those borrowed buffers: the new state is written in place,
+        the donated arrays are dropped, the numpy owner gets collected, and
+        the pages are freed for reuse while the live state still aliases
+        them — observed as nondeterministic garbage in a handful of param
+        leaves on the first post-restore eval. Routing every leaf through
+        ``jnp.copy`` materializes runtime-owned buffers and severs the
+        aliasing (~13 MB once per restore/rollback; irrelevant cost).
+        """
+        rep = replicated(self.mesh)
+        put = jax.device_put(host_state, rep)
+        owned = jax.tree.map(jnp.copy, put)
+        jax.block_until_ready(owned)
+        return owned
+
+    def _host_state_copy(self):
+        """Deep HOST copy of the live train state (rollback snapshot).
+
+        ``jax.device_get`` alone returns zero-copy numpy VIEWS on CPU; a
+        later donated step overwrites the viewed memory, silently turning a
+        "snapshot" into whatever the run computed next. The explicit
+        ``np.array(copy=True)`` pins the bytes at snapshot time.
+        """
+        import numpy as np
+
+        return jax.tree.map(
+            lambda x: np.array(x, copy=True), jax.device_get(self.state)
         )

@@ -47,10 +47,49 @@ def _unflatten(flat: dict) -> dict:
 
 
 def save_weights(params, path) -> Path:
-    """Save a param pytree as a flat npz."""
+    """Save a param pytree as a flat npz — atomically.
+
+    Write to a temp file in the same directory, then ``os.replace``: a crash
+    mid-save can never leave a truncated ``last.npz`` that
+    :func:`load_weights` chokes on (same protocol as :func:`export_weights`).
+    The temp name keeps the ``.npz`` suffix because ``np.savez`` appends it
+    otherwise.
+    """
+    import os
+
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    np.savez(path, **_flatten(params))
+    tmp = path.parent / f".{path.name}.tmp.npz"
+    try:
+        np.savez(tmp, **_flatten(params))
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
+    return path
+
+
+def save_state_atomic(state_tree, path) -> Path:
+    """Orbax-save a state pytree with atomic finalize (tmp + ``os.replace``).
+
+    A preemption or crash mid-save leaves only a ``.tmp-*`` directory; the
+    final path either doesn't exist or is a complete checkpoint. Multi-host:
+    the Orbax save is process-collective (every process must call this with
+    the same path — it synchronizes internally); only process 0 performs the
+    rename, after the collective save has completed on all hosts.
+    """
+    import os
+    import shutil
+
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    tmp = path.parent / f".tmp-{path.name}"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    ocp.PyTreeCheckpointer().save(tmp, state_tree, force=True)
+    if jax.process_index() == 0:
+        if path.exists():
+            shutil.rmtree(path)
+        os.replace(tmp, path)
     return path
 
 

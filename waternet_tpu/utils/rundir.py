@@ -27,13 +27,29 @@ def next_run_dir(base: Path, name: str | None = None) -> Path:
 
 def latest_run_dir(base: Path) -> Path | None:
     """The highest-numbered existing run dir under ``base``, or None."""
+    dirs = run_dirs_desc(base)
+    return dirs[0] if dirs else None
+
+
+def run_dirs_desc(base: Path) -> list[Path]:
+    """All numbered run dirs under ``base``, newest (highest) first.
+
+    ``--resume auto`` walks this: when the latest run holds nothing
+    restorable (no checkpoints yet, or all of them corrupt), resume falls
+    back to earlier runs instead of crashing or silently starting over.
+    """
     base = Path(base)
     if not base.exists():
-        return None
-    nums = [
-        int(p.stem) for p in base.glob("*") if p.is_dir() and p.stem.isdecimal()
-    ]
-    return base / str(max(nums)) if nums else None
+        return []
+    nums = sorted(
+        (
+            int(p.stem)
+            for p in base.glob("*")
+            if p.is_dir() and p.stem.isdecimal()
+        ),
+        reverse=True,
+    )
+    return [base / str(n) for n in nums]
 
 
 def ensure_dir(path: Path) -> Path:
