@@ -9,9 +9,13 @@ TPU-native differences:
 * the forward pass is one jitted XLA program; repeated same-shape calls reuse
   the compiled executable;
 * video frames are processed in **batches with host/device pipelining**
-  (``--batch-size``, default 4): the host decodes/preprocesses batch N+1
-  while the TPU runs batch N — the reference runs strictly frame-at-a-time
-  (`/root/reference/inference.py:261-323`);
+  (``--batch-size``, default 4): a background thread decodes batch N+1
+  while the TPU runs batch N and the consumer writes N-1 — the reference
+  runs strictly frame-at-a-time (`/root/reference/inference.py:261-323`);
+* directory sources decode through the same overlapped input pipeline
+  (``--workers``, docs/PIPELINE.md): the next batch's images decode in
+  worker threads while the device enhances the current one, with output
+  order and batching identical to synchronous decoding;
 * ``--device-preprocess`` moves WB/GC/CLAHE onto the TPU (tolerance-level
   parity, see waternet_tpu.ops), which is the fast path when host CPU is
   scarce.
@@ -73,6 +77,14 @@ def parse_args(argv=None):
         action="store_true",
         default=False,
         help="(Optional) Run WB/GC/CLAHE on the accelerator instead of host.",
+    )
+    parser.add_argument(
+        "--workers",
+        type=int,
+        default=2,
+        help="(Optional) Input-pipeline worker threads (docs/PIPELINE.md): "
+        "directory sources decode N images ahead of the device; video "
+        "sources decode batches on a background thread. 0 = synchronous.",
     )
     parser.add_argument(
         "--precision",
@@ -165,7 +177,8 @@ def make_split(bgr_before, bgr_after):
 
 
 def run_images_batched(
-    engine, paths, savedir: Path, show_split: bool, batch_size: int
+    engine, paths, savedir: Path, show_split: bool, batch_size: int,
+    workers: int = 2,
 ):
     """Enhance a stream of image files with shape-aware batching.
 
@@ -175,8 +188,16 @@ def run_images_batched(
     pending batch, so mixed-resolution directories degrade to the
     reference's one-image-at-a-time behavior (`/root/reference/
     inference.py:167-233`) rather than recompiling per permutation.
+
+    Decode runs through the overlapped input pipeline (``workers`` threads,
+    docs/PIPELINE.md): images for the next batch decode while the device
+    enhances and the consumer writes the current one. Results arrive in
+    path order regardless of worker scheduling, so batching, grouping, and
+    output files are identical to the synchronous path (``workers=0``).
     """
     import cv2
+
+    from waternet_tpu.data.pipeline import OrderedPipeline
 
     pending = []  # [(path, bgr, rgb)] — all same shape
 
@@ -192,20 +213,32 @@ def run_images_batched(
             cv2.imwrite(str(savedir / path.name), out)
         pending.clear()
 
-    for path in paths:
+    def decode(path):
         bgr = cv2.imread(str(path))
         if bgr is None:
-            print(f"Skipping unreadable image: {path}", file=sys.stderr)
-            continue
-        if pending and bgr.shape != pending[0][1].shape:
-            flush()
-        pending.append((path, bgr, cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)))
-        if len(pending) >= batch_size:
-            flush()
+            return path, None, None
+        return path, bgr, cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+
+    pipe = OrderedPipeline(decode, paths, workers=workers, name="decode")
+    try:
+        for path, bgr, rgb in pipe:
+            if bgr is None:
+                print(f"Skipping unreadable image: {path}", file=sys.stderr)
+                continue
+            if pending and bgr.shape != pending[0][1].shape:
+                flush()
+            pending.append((path, bgr, rgb))
+            if len(pending) >= batch_size:
+                flush()
+    finally:
+        pipe.close()
     flush()
 
 
-def run_video(engine, path: Path, savedir: Path, show_split: bool, batch_size: int):
+def run_video(
+    engine, path: Path, savedir: Path, show_split: bool, batch_size: int,
+    workers: int = 2,
+):
     import cv2
 
     from waternet_tpu.data.video import enhance_video_stream
@@ -231,7 +264,11 @@ def run_video(engine, path: Path, savedir: Path, show_split: bool, batch_size: i
         raise RuntimeError(f"could not open any mp4 encoder for {outpath}")
 
     n = 0
-    for bgr_in, bgr_out in enhance_video_stream(engine, cap, batch_size=batch_size):
+    stream = enhance_video_stream(
+        engine, cap, batch_size=batch_size,
+        prefetch=2 if workers > 0 else 0,
+    )
+    for bgr_in, bgr_out in stream:
         frame = make_split(bgr_in, bgr_out) if show_split else bgr_out
         writer.write(frame)
         n += 1
@@ -295,11 +332,15 @@ def main(argv=None):
     image_files = [f for f in files if f.suffix.lower() in IM_SUFFIXES]
     if image_files:
         run_images_batched(
-            engine, image_files, savedir, args.show_split, args.batch_size
+            engine, image_files, savedir, args.show_split, args.batch_size,
+            workers=args.workers,
         )
     for f in files:
         if f.suffix.lower() in VID_SUFFIXES:
-            run_video(engine, f, savedir, args.show_split, args.batch_size)
+            run_video(
+                engine, f, savedir, args.show_split, args.batch_size,
+                workers=args.workers,
+            )
     print(f"Saved output to {savedir}!")
 
 

@@ -124,6 +124,11 @@ def parse_args(argv=None):
     p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual metric")
     p.add_argument("--precision", type=str, default="fp32", choices=["bf16", "fp32"])
     p.add_argument("--device-preprocess", action="store_true")
+    p.add_argument("--workers", type=int, default=2, metavar="N",
+                   help="Overlapped input pipeline (docs/PIPELINE.md): N "
+                        "worker threads load + preprocess eval batches ahead "
+                        "of the device. 0 = synchronous; metric values are "
+                        "identical either way")
     p.add_argument("--bug-compat-perceptual", action="store_true",
                    help="Reproduce the reference's perceptual_loss accumulation bug")
     p.add_argument("--json-out", type=str, help="Also write metrics to this JSON file")
@@ -347,7 +352,18 @@ def main(argv=None):
     )
 
     if args.bug_compat_perceptual:
+        # Bug-compat accumulates per-batch on the host; stays synchronous.
         metrics = _eval_bug_compat(engine, dataset, indices, args.batch_size)
+    elif args.workers > 0:
+        metrics = engine.eval_epoch_pipelined(
+            dataset, indices, workers=args.workers
+        )
+        # The scorer's contract output is the parity-grade metric dict;
+        # keep the pipeline instrumentation out of it (train.py and
+        # bench.py are where those numbers are reported).
+        metrics = {
+            k: v for k, v in metrics.items() if not k.startswith("pipeline_")
+        }
     else:
         metrics = engine.eval_epoch(
             dataset.batches(indices, args.batch_size, shuffle=False)

@@ -29,8 +29,44 @@ import jax._src.xla_bridge as _xb  # noqa: E402
 _xb._backend_factories.pop("axon", None)
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache for the WHOLE suite, not just from the first
+# in-process CLI test onward (the CLIs enable it themselves): the suite
+# builds dozens of TrainingEngines whose tiny step programs are identical,
+# and each fresh engine re-lowers the same HLO — with the cache, every
+# program compiles once per run and deserializes afterwards. This is the
+# same cache the production CLIs use (waternet_tpu/utils/platform.py).
+from waternet_tpu.utils.platform import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_pipeline_worker_leak():
+    """Thread-leak guard: after every test, no input-pipeline worker thread
+    may survive (waternet_tpu/data/pipeline.py names them all under
+    THREAD_PREFIX). A leaked worker means a shutdown bug — an abandoned
+    OrderedPipeline/PrefetchIterator that was never close()d — which tier-1
+    would otherwise miss entirely: the suite would pass and the leak would
+    only surface as a hang or fd exhaustion in production."""
+    import threading
+
+    yield
+    from waternet_tpu.data.pipeline import THREAD_PREFIX
+
+    leaked = [
+        t for t in threading.enumerate() if t.name.startswith(THREAD_PREFIX)
+    ]
+    for t in leaked:  # grace for threads mid-exit from a racing shutdown
+        t.join(timeout=2.0)
+    leaked = [
+        t.name
+        for t in threading.enumerate()
+        if t.name.startswith(THREAD_PREFIX)
+    ]
+    assert not leaked, f"leaked pipeline worker threads: {leaked}"
 
 
 @pytest.fixture(scope="session")

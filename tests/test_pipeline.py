@@ -1,0 +1,437 @@
+"""Overlapped input pipeline tests (waternet_tpu/data/pipeline.py).
+
+The guarantees pinned here:
+
+* ordered delivery, clean shutdown, and exception propagation of the
+  pipeline primitives themselves;
+* pipelined host-fed training is BYTE-identical to the synchronous path —
+  engine-level (state leaves + metrics, host and device preprocessing) and
+  CLI-level (CSVs + weights, fp32 and bf16);
+* mid-epoch SIGTERM -> resume *through the pipeline* replays the epoch
+  bit-for-bit (same bar as the synchronous resilience tests);
+* decode faults raised inside pipeline workers still retry/quarantine;
+* the overlap actually hides host work: with an injected host-stage delay
+  the pipelined epoch runs in < 0.7x the serial wall time, and the stall
+  counter distinguishes the two.
+"""
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from waternet_tpu.resilience import faults
+
+ARGS = [
+    "--synthetic", "8", "--batch-size", "4", "--height", "32", "--width", "32",
+    "--no-perceptual",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _tiny_config(**kw):
+    from waternet_tpu.training.trainer import TrainConfig
+
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("im_height", 32)
+    kw.setdefault("im_width", 32)
+    kw.setdefault("precision", "fp32")
+    kw.setdefault("perceptual_weight", 0.0)
+    return TrainConfig(**kw)
+
+
+def _run_cli(tmp_base, name, argv, monkeypatch):
+    """Run train.py's main with run dirs redirected under tmp_base."""
+    import train as cli
+    import waternet_tpu.utils.rundir as rundir
+
+    d = Path(tmp_base) / name
+    monkeypatch.setattr(rundir, "next_run_dir", lambda base, name=None: d)
+    monkeypatch.setattr(
+        rundir,
+        "run_dirs_desc",
+        lambda base: sorted(
+            (p for p in Path(tmp_base).iterdir() if p.is_dir()),
+            key=lambda p: p.stat().st_mtime,
+            reverse=True,
+        ),
+    )
+    cli.main(ARGS + argv)
+    return d
+
+
+def _assert_run_artifacts_identical(a: Path, b: Path):
+    assert (a / "metrics-train.csv").read_bytes() == (
+        b / "metrics-train.csv"
+    ).read_bytes()
+    assert (a / "metrics-val.csv").read_bytes() == (
+        b / "metrics-val.csv"
+    ).read_bytes()
+    wa, wb = np.load(a / "last.npz"), np.load(b / "last.npz")
+    assert sorted(wa.files) == sorted(wb.files)
+    assert all(np.array_equal(wa[k], wb[k]) for k in wa.files)
+
+
+# ----------------------------------------------------------------------
+# Pipeline primitives
+# ----------------------------------------------------------------------
+
+
+def test_ordered_pipeline_delivers_in_order():
+    from waternet_tpu.data.pipeline import OrderedPipeline
+
+    def work(i):
+        # Earlier items sleep longer: workers finish OUT of submission
+        # order, delivery must still be IN order.
+        time.sleep(0.02 if i % 3 == 0 else 0.0)
+        return i * i
+
+    pipe = OrderedPipeline(work, range(24), workers=4)
+    assert list(pipe) == [i * i for i in range(24)]
+    assert pipe.stats.pops == 24
+    pipe.close()  # idempotent
+
+
+def test_ordered_pipeline_inline_mode_is_all_stalls():
+    from waternet_tpu.data.pipeline import OrderedPipeline
+
+    pipe = OrderedPipeline(lambda i: i + 1, range(5), workers=0)
+    assert list(pipe) == [1, 2, 3, 4, 5]
+    assert pipe.stats.stall_pct() == 100.0
+    assert pipe.stats.workers == 0
+
+
+def test_ordered_pipeline_propagates_worker_exception_in_order():
+    from waternet_tpu.data.pipeline import OrderedPipeline
+
+    def work(i):
+        if i == 3:
+            raise RuntimeError("boom at 3")
+        return i
+
+    pipe = OrderedPipeline(work, range(8), workers=2)
+    got = []
+    with pytest.raises(RuntimeError, match="boom at 3"):
+        for r in pipe:
+            got.append(r)
+    assert got == [0, 1, 2]  # everything before the failing item, in order
+    pipe.close()
+
+
+def test_ordered_pipeline_close_mid_iteration_joins_workers():
+    from waternet_tpu.data.pipeline import OrderedPipeline
+
+    pipe = OrderedPipeline(lambda i: i, range(100), workers=3)
+    assert next(pipe) == 0
+    pipe.close()  # conftest leak guard asserts the workers are gone
+    with pytest.raises(StopIteration):
+        next(pipe)
+
+
+def test_prefetch_iterator_order_errors_and_early_close():
+    from waternet_tpu.data.pipeline import PrefetchIterator
+
+    it = PrefetchIterator(iter(range(10)), depth=3)
+    assert list(it) == list(range(10))
+    it.close()  # idempotent after exhaustion
+
+    def gen_with_error():
+        yield 1
+        raise ValueError("stream died")
+
+    it = PrefetchIterator(gen_with_error(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="stream died"):
+        next(it)
+
+    # Early close: the producer must stop promptly even while blocked on
+    # the bounded queue (consumer abandons the stream mid-iteration).
+    it = PrefetchIterator(iter(range(10_000)), depth=2)
+    assert next(it) == 0
+    it.close()
+
+
+# ----------------------------------------------------------------------
+# Byte-identity: pipelined vs synchronous training
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "host_preprocess",
+    [
+        False,
+        # The host-preprocess variant re-proves the same invariant through
+        # the cv2 path + per-batch RNG-state cloning; heavyweight (extra
+        # train_step_pre engines), so it runs outside the tier-1 budget.
+        pytest.param(True, marks=pytest.mark.slow),
+    ],
+    ids=["device-preprocess", "host-preprocess"],
+)
+def test_pipelined_epoch_matches_synchronous(host_preprocess):
+    """Same Philox batch composition, same augment draws, same step
+    programs: the pipelined epoch must reproduce the synchronous epoch
+    EXACTLY (float equality, not approx) — including a padded tail batch —
+    and report the pipeline instrumentation keys."""
+    import jax
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    cfg = _tiny_config(
+        shuffle=True, augment=True, host_preprocess=host_preprocess
+    )
+    n = 10  # 3 batches/epoch, tail of 2 exercises padding + masking
+    ds = SyntheticPairs(n, 32, 32, seed=0)
+    idx = np.arange(n)
+
+    sync_eng = TrainingEngine(cfg)
+    pipe_eng = TrainingEngine(cfg)
+    for epoch in range(2):
+        m_sync = sync_eng.train_epoch(
+            ds.batches(idx, 4, shuffle=True, seed=cfg.seed, epoch=epoch),
+            epoch=epoch,
+        )
+        m_pipe = pipe_eng.train_epoch_pipelined(
+            ds, idx, epoch=epoch, workers=2
+        )
+        for k in m_sync:
+            assert m_sync[k] == m_pipe[k], (epoch, k, m_sync[k], m_pipe[k])
+        # The instrumentation contract: stall counter + per-stage timings.
+        assert "pipeline_stall_pct" in m_pipe
+        assert m_pipe["pipeline_workers"] == 2.0
+        for stage in ("load", "preprocess", "transfer", "step"):
+            assert f"pipeline_{stage}_ms" in m_pipe
+        if host_preprocess:
+            assert m_pipe["pipeline_preprocess_ms"] > 0
+
+    a = jax.tree_util.tree_leaves(jax.device_get(sync_eng.state))
+    b = jax.tree_util.tree_leaves(jax.device_get(pipe_eng.state))
+    assert all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+    # Eval parity: same bar for the validation path.
+    e_sync = sync_eng.eval_epoch(ds.batches(idx, 4, shuffle=False))
+    e_pipe = pipe_eng.eval_epoch_pipelined(ds, idx, workers=2)
+    for k in e_sync:
+        assert e_sync[k] == e_pipe[k], (k, e_sync[k], e_pipe[k])
+    assert "pipeline_stall_pct" in e_pipe
+
+
+@pytest.mark.slow
+def test_pipelined_host_preprocess_midepoch_resume_matches_uninterrupted():
+    """The precomputed per-batch augment RNG states must mirror the padded
+    draw consumption of a skipped prefix (conftest forces 8 CPU devices, so
+    batch 4 pads to 8 rows and padded rows consume draws too). Slow tier:
+    tier-1 covers pipelined mid-epoch resume end to end via
+    test_resilience's SIGTERM tests (default --workers 2)."""
+    import jax
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    cfg = _tiny_config(host_preprocess=True, shuffle=False)
+    ds = SyntheticPairs(8, 32, 32, seed=0)
+    idx = np.arange(8)
+
+    full = TrainingEngine(cfg)
+    full.train_epoch_pipelined(ds, idx, epoch=0, workers=2)
+
+    resumed = TrainingEngine(cfg)
+    resumed.train_epoch_pipelined(
+        ds, idx[:4], epoch=0, workers=2
+    )  # first batch only
+    resumed.train_epoch_pipelined(
+        ds, idx, epoch=0, workers=2, start_batch=1, start_items=4
+    )
+    a = jax.tree_util.tree_leaves(jax.device_get(full.state))
+    b = jax.tree_util.tree_leaves(jax.device_get(resumed.state))
+    assert all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+
+@pytest.mark.slow
+def test_pipelined_cli_byte_identical_and_sigterm_resume(tmp_path, monkeypatch):
+    """The pinned artifact-level guarantees, fp32, sharing one synchronous
+    baseline run: (a) --workers 2 produces byte-for-byte the CSVs and
+    weights of --workers 0; (b) SIGTERM mid-epoch through the pipeline
+    drains at the step boundary (workers joined, prefetched batches
+    discarded), checkpoints the exact position, and the resumed PIPELINED
+    run reproduces the uninterrupted SYNCHRONOUS baseline byte-for-byte —
+    the cross-mode closure of the byte-identity guarantee."""
+    extra = ["--epochs", "2", "--precision", "fp32"]
+    sync = _run_cli(
+        tmp_path / "base", "sync", ["--workers", "0"] + extra, monkeypatch
+    )
+    piped = _run_cli(
+        tmp_path / "pipe", "p", ["--workers", "2"] + extra, monkeypatch
+    )
+    _assert_run_artifacts_identical(sync, piped)
+
+    work = tmp_path / "work"
+    faults.install(faults.FaultPlan.parse("sigterm@3"))
+    interrupted = _run_cli(
+        work, "0", ["--workers", "2"] + extra, monkeypatch
+    )
+    faults.clear()
+    cks = sorted((interrupted / "checkpoints").glob("step-*"))
+    meta = json.loads((cks[-1] / "_COMPLETE.json").read_text())
+    assert (meta["epoch"], meta["batch_index"]) == (1, 1)
+    assert not (interrupted / "metrics-train.csv").exists()  # died mid-run
+
+    resumed = _run_cli(
+        work, "1", ["--workers", "2", "--resume", "auto"] + extra, monkeypatch
+    )
+    _assert_run_artifacts_identical(sync, resumed)
+
+
+@pytest.mark.slow
+def test_pipelined_cli_byte_identical_bf16(tmp_path, monkeypatch):
+    """Same artifact-level byte-identity in the bf16 config (the production
+    precision): rounding inside the step must see identical inputs in
+    identical order either way."""
+    extra = ["--epochs", "1", "--precision", "bf16"]
+    sync = _run_cli(
+        tmp_path / "sync", "s", ["--workers", "0"] + extra, monkeypatch
+    )
+    piped = _run_cli(
+        tmp_path / "pipe", "p", ["--workers", "2"] + extra, monkeypatch
+    )
+    _assert_run_artifacts_identical(sync, piped)
+
+
+# ----------------------------------------------------------------------
+# Decode faults inside workers
+# ----------------------------------------------------------------------
+
+
+def _write_pairs(tmp_path, n=4):
+    import cv2
+
+    raw, ref = tmp_path / "raw", tmp_path / "ref"
+    raw.mkdir(), ref.mkdir()
+    for i in range(n):
+        cv2.imwrite(str(raw / f"{i}.png"), np.full((16, 16, 3), i, np.uint8))
+        cv2.imwrite(str(ref / f"{i}.png"), np.full((16, 16, 3), i, np.uint8))
+    return raw, ref
+
+
+def test_transient_decode_fault_in_workers_is_retried(tmp_path, monkeypatch):
+    """A WATERNET_FAULTS decode event firing inside a pipeline worker is
+    absorbed by _imread_retry: the loaded data is identical to a fault-free
+    run and the plan records the firing."""
+    pytest.importorskip("cv2")
+    from waternet_tpu.data.pipeline import OrderedPipeline
+    from waternet_tpu.data.uieb import UIEBDataset
+
+    raw, ref = _write_pairs(tmp_path)
+    clean_ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    clean = list(
+        OrderedPipeline(clean_ds.load_pair, range(4), workers=2, name="t")
+    )
+
+    monkeypatch.setenv("WATERNET_FAULTS", "decode@2")
+    plan = faults.install_from_env()
+    faulted_ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    got = list(
+        OrderedPipeline(faulted_ds.load_pair, range(4), workers=2, name="t")
+    )
+    assert ("decode", 2) in plan.fired  # the fault actually hit a worker
+    assert faulted_ds.quarantined == []  # retry absorbed it
+    for (r0, f0), (r1, f1) in zip(clean, got):
+        assert np.array_equal(r0, r1) and np.array_equal(f0, f1)
+
+
+def test_persistent_decode_fault_in_workers_quarantines(tmp_path):
+    """Enough consecutive decode events to exhaust the retries: the worker
+    raises CorruptPairError, it propagates at the consumer's pop in order,
+    the pair is quarantined, and the pipeline shuts down cleanly (the
+    conftest leak guard would catch surviving workers)."""
+    pytest.importorskip("cv2")
+    from waternet_tpu.data.pipeline import OrderedPipeline
+    from waternet_tpu.data.uieb import CorruptPairError, UIEBDataset
+
+    # _imread_retry makes 1 + 2 attempts; kill all three of the first read.
+    faults.install(faults.FaultPlan.parse("decode@1,decode@2,decode@3"))
+    raw, ref = _write_pairs(tmp_path)
+    ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    pipe = OrderedPipeline(ds.load_pair, range(4), workers=1, name="t")
+    with pytest.raises(CorruptPairError, match="0.png"):
+        list(pipe)
+    assert ds.quarantined == ["0.png"]
+
+
+# ----------------------------------------------------------------------
+# The overlap itself
+# ----------------------------------------------------------------------
+
+
+class _SlowPairs:
+    """SyntheticPairs with an injected per-item host-stage delay."""
+
+    def __init__(self, n, hw, delay_s=0.0):
+        from waternet_tpu.data.synthetic import SyntheticPairs
+
+        self._ds = SyntheticPairs(n, hw, hw, seed=0)
+        self.delay_s = delay_s
+
+    def __len__(self):
+        return len(self._ds)
+
+    def load_pair(self, idx):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return self._ds.load_pair(idx)
+
+
+def test_pipelined_overlap_hides_host_stage():
+    """With an artificial host-stage delay (>= 20 ms per batch, scaled up
+    on slow hosts so it dominates the step), the pipelined epoch must run
+    in < 0.7x the serial wall time — the sleep releases the GIL, so even a
+    1-core host can overlap it with device compute. The stall counter must
+    tell the two runs apart."""
+    from waternet_tpu.data.synthetic import SyntheticPairs  # noqa: F401
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    n, bs, hw = 12, 4, 32
+    # augment=True so the step program is the SAME HLO the byte-identity
+    # test above compiled — the suite-wide compile cache then deserializes
+    # instead of recompiling (shuffle doesn't enter the program).
+    cfg = _tiny_config(batch_size=bs, shuffle=False, augment=True)
+    eng = TrainingEngine(cfg)
+    ds = _SlowPairs(n, hw, delay_s=0.0)
+    idx = np.arange(n)
+    n_batches = n // bs
+
+    # Compile/pair-gen warmup on ONE batch, then time the steps alone.
+    eng.train_epoch_pipelined(ds, idx[:bs], epoch=0, workers=0)
+    t0 = time.perf_counter()
+    eng.train_epoch_pipelined(ds, idx, epoch=1, workers=0)
+    per_batch_step = (time.perf_counter() - t0) / n_batches
+
+    # Host-stage delay per batch: at least 20 ms, and at least 2x the
+    # step so the host stage dominates (otherwise overlap can't reach the
+    # 0.7x bound by construction: serial = step + load, pipelined ~ max).
+    ds.delay_s = max(0.030, 2.0 * per_batch_step) / bs
+
+    t0 = time.perf_counter()
+    m_serial = eng.train_epoch_pipelined(ds, idx, epoch=2, workers=0)
+    t_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m_pipe = eng.train_epoch_pipelined(ds, idx, epoch=3, workers=4)
+    t_pipe = time.perf_counter() - t0
+
+    assert t_pipe < 0.7 * t_serial, (t_pipe, t_serial, per_batch_step)
+    assert m_serial["pipeline_stall_pct"] == 100.0
+    assert m_pipe["pipeline_stall_pct"] < 100.0
+    # The injected delay is visible in the load stage it was injected into.
+    assert m_pipe["pipeline_load_ms"] >= 20.0

@@ -119,8 +119,13 @@ def run_bench(extra_env, args=(), timeout=None):
     line = lines[-1] if lines else None
     if line is not None:
         for extra in lines[:-1]:
-            if str(extra.get("metric", "")).endswith("_hostfed"):
+            metric = str(extra.get("metric", ""))
+            if metric.endswith("_hostfed"):
                 line["hostfed_line"] = extra
+            elif metric.endswith("_hostfed_sync"):
+                # The pipeline A/B's synchronous variant (workers=0),
+                # printed before the host-fed line since the pipeline PR.
+                line["hostfed_sync_line"] = extra
     if line is None:
         line = {
             "error": "no JSON line",

@@ -538,11 +538,16 @@ def main():
     # the round-2 "train_bf16" entry stays as the before side. The r3
     # names were never measured (tunnel dead since round 2) and are
     # superseded by these.
+    # pipeline_ab: the headline host-fed stage also measures the overlapped
+    # input pipeline on hardware (pipeline_stall_pct + a workers=0 epoch
+    # A/B under "hostfed_sync") — docs/PIPELINE.md. Only this stage pays
+    # for it; the batch-scaling and A/B stages below keep the plain step
+    # measurement.
     s.run_stage(
         "train_bf16_r5",
         lambda: bench.measure_train(
             batch=args.batch, hw=args.hw, precision="bf16", warmup=3,
-            steps=args.train_steps,
+            steps=args.train_steps, pipeline_ab=True,
         ),
     )
     # The HBM-resident + precached-transforms step (the --device-cache

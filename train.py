@@ -23,6 +23,12 @@ TPU-native additions:
   half-written or corrupt ones (docs/RESILIENCE.md).
 * synthetic-data fallback: with no dataset on disk, ``--synthetic N`` trains
   on procedurally generated pairs (CI / bench environments).
+* overlapped input pipeline, ON by default for the host-fed paths
+  (``--workers 2``; ``--workers 0`` restores synchronous loading): pair
+  loading + host preprocessing + the next batch's H2D transfer run in a
+  bounded worker pool while the device executes the current step —
+  byte-identical training (docs/PIPELINE.md), with ``pipeline_stall_pct``
+  and per-stage timings reported in the epoch metrics.
 
 Fault tolerance (docs/RESILIENCE.md): SIGTERM/SIGINT checkpoint the run at
 the next step boundary with its exact dataloader position, so a preempted
@@ -59,6 +65,10 @@ def parse_args(argv=None):
     p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual loss")
     p.add_argument("--no-perceptual", action="store_true", help="Disable the VGG perceptual term")
     p.add_argument("--host-preprocess", action="store_true", help="cv2/NumPy WB+GC+CLAHE on host (bit-exact, slow)")
+    p.add_argument("--workers", type=int, default=2, metavar="N",
+                   help="Overlapped input pipeline for the host-fed paths (docs/PIPELINE.md): N worker threads load + preprocess batches ahead of the device step, byte-identical to the synchronous path. 0 disables (synchronous loading); ignored with --device-cache (no per-step host feed to hide)")
+    p.add_argument("--prefetch", type=int, default=0, metavar="K",
+                   help="Bounded prefetch depth of the input pipeline (batches in flight; default 0 = 2x workers)")
     p.add_argument("--device-cache", action="store_true", help="Pin the whole uint8 dataset in device memory (UIEB@112x112 ~60 MB) and gather batches on device: zero per-step host feed, bit-identical epochs (same Philox shuffle + augment streams)")
     p.add_argument("--no-precache-histeq", action="store_true", help="With --device-cache: keep WB/GC/CLAHE inside the step instead of precomputing them (CLAHE per dihedral augmentation variant) at cache-build time. Precaching is default because it removes ~half the measured step time at a few hundred MB of HBM")
     p.add_argument("--precache-vgg-ref", action="store_true", help="With --device-cache: also precompute the perceptual term's VGG features of every dihedral ref variant at cache-build time (the ref branch carries no gradient), removing ~8.6%% of step FLOPs (docs/MFU.md). Default off pending hardware A/B; numerics equivalent within compute-dtype tolerance")
@@ -296,6 +306,24 @@ def main(argv=None):
                     train_metrics = engine.train_epoch_cached(
                         epoch=epoch, start_batch=sb, control=control, carry=cy
                     )
+                elif args.workers > 0:
+                    # Overlapped input pipeline (docs/PIPELINE.md): workers
+                    # load + preprocess ahead; byte-identical to the
+                    # synchronous branch below (pinned in
+                    # tests/test_pipeline.py), incl. mid-epoch resume.
+                    train_metrics = engine.train_epoch_pipelined(
+                        dataset,
+                        train_idx,
+                        epoch=epoch,
+                        workers=args.workers,
+                        prefetch=args.prefetch,
+                        start_batch=sb,
+                        start_items=min(
+                            sb * config.batch_size, len(train_idx)
+                        ),
+                        control=control,
+                        carry=cy,
+                    )
                 else:
                     train_metrics = engine.train_epoch(
                         dataset.batches(
@@ -325,6 +353,11 @@ def main(argv=None):
             if args.device_cache:
                 val_metrics = engine.eval_epoch_cached(
                     dataset=dataset, indices=val_idx
+                )
+            elif args.workers > 0:
+                val_metrics = engine.eval_epoch_pipelined(
+                    dataset, val_idx,
+                    workers=args.workers, prefetch=args.prefetch,
                 )
             else:
                 val_metrics = engine.eval_epoch(

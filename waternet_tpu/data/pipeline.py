@@ -1,0 +1,322 @@
+"""Overlapped, deterministic input pipeline for the host-fed paths.
+
+The measured host-fed train step (docs/TPU_RESULTS.md, BENCH_r02) spends
+~22 ms of its ~48 ms on host work — pair loading and classical transforms
+executed synchronously *between* device steps. This module moves all of
+that off the step's critical path, the standard trick from fast
+fully-convolutional pipelines (Chen et al. 2017; Johnson et al. 2016):
+
+* :class:`OrderedPipeline` — a bounded worker pool (threads; cv2/NumPy
+  release the GIL) runs a produce function over a work list ahead of the
+  consumer and delivers results **in submission order** through a bounded
+  prefetch window. Because batch composition is already a pure function of
+  ``(seed, epoch)`` (:func:`waternet_tpu.data.batching.epoch_permutation`)
+  and each work item carries everything its batch needs (indices and, for
+  the host-preprocess path, a pre-advanced RNG state), workers may race
+  ahead and finish out of order without changing what the consumer sees —
+  the overlap is observationally free, which is what makes the pipelined
+  epoch byte-identical to the synchronous one (pinned in
+  tests/test_pipeline.py).
+* :class:`PrefetchIterator` — a single background thread draining a strictly
+  sequential source (a video capture, a tail -f-style stream) into a
+  bounded queue; same ordering/shutdown/error contract for sources that
+  cannot be fanned out.
+* :class:`PipelineStats` — per-stage timings (load / preprocess / transfer /
+  step), a queue-depth gauge, and the consumer **stall counter** (pops that
+  had to wait for the batch to be ready). ``stall_pct`` near 0 is the
+  number that proves the overlap on hardware; it surfaces in epoch metrics
+  and in bench.py's host-fed line as ``pipeline_stall_pct``.
+
+Both iterators run their threads under the :data:`THREAD_PREFIX` name so
+tests can assert clean shutdown (tests/conftest.py leak guard); ``close()``
+is idempotent, joins every worker, and is safe to call from ``finally``
+blocks mid-iteration (the SIGTERM drain path: the trainer stops consuming
+at a step boundary, in-flight work items finish, queued ones are
+cancelled). Exceptions raised inside workers (e.g.
+:class:`waternet_tpu.data.uieb.CorruptPairError` after decode retries)
+re-raise at the consumer's pop for that item, in order.
+
+``workers=0`` runs the identical code path inline on the consumer thread —
+the instrumented synchronous reference for A/B runs (bench.py's
+``_hostfed_sync`` line).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+from typing import Callable, Iterable, Iterator, Optional
+
+THREAD_PREFIX = "waternet-pipeline"
+
+STAGES = ("load", "preprocess", "transfer", "step")
+
+
+class PipelineStats:
+    """Thread-safe accumulators for pipeline instrumentation.
+
+    Workers call :meth:`add_stage`/:meth:`stage` for host-stage timings;
+    the consumer's pop loop calls :meth:`note_pop` with whether it stalled
+    (the batch was not ready) and the ready-queue depth it observed.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stage_s: dict = {}
+        self._stage_n: dict = {}
+        self.pops = 0
+        self.stalls = 0
+        self.stall_s = 0.0
+        self._depth_sum = 0
+        self.depth_max = 0
+        self.workers = 0
+
+    def add_stage(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._stage_s[name] = self._stage_s.get(name, 0.0) + seconds
+            self._stage_n[name] = self._stage_n.get(name, 0) + 1
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_stage(name, time.perf_counter() - t0)
+
+    def note_pop(self, stalled: bool, waited_s: float, depth: int) -> None:
+        with self._lock:
+            self.pops += 1
+            if stalled:
+                self.stalls += 1
+                self.stall_s += waited_s
+            self._depth_sum += depth
+            self.depth_max = max(self.depth_max, depth)
+
+    def stage_ms(self, name: str) -> float:
+        """Mean per-call milliseconds for ``name`` (0.0 when never timed)."""
+        with self._lock:
+            n = self._stage_n.get(name, 0)
+            return (self._stage_s.get(name, 0.0) / n * 1e3) if n else 0.0
+
+    def stall_pct(self) -> float:
+        with self._lock:
+            return 100.0 * self.stalls / max(self.pops, 1)
+
+    def queue_depth_mean(self) -> float:
+        with self._lock:
+            return self._depth_sum / max(self.pops, 1)
+
+    def metrics(self, prefix: str = "pipeline_") -> dict:
+        """Flat float dict for epoch metrics / bench JSON lines."""
+        out = {
+            f"{prefix}stall_pct": round(self.stall_pct(), 2),
+            f"{prefix}queue_depth": round(self.queue_depth_mean(), 2),
+            f"{prefix}workers": float(self.workers),
+        }
+        for name in STAGES:
+            out[f"{prefix}{name}_ms"] = round(self.stage_ms(name), 3)
+        return out
+
+
+class OrderedPipeline:
+    """Bounded worker pool delivering ``fn(item)`` results in submission order.
+
+    Up to ``prefetch`` items are in flight at once (default
+    ``max(2 * workers, workers + 1)``); workers complete in any order but
+    the consumer always receives the head of the submission FIFO, so
+    delivery order equals ``items`` order regardless of scheduling. A stall
+    is a pop whose head future was not yet done — the consumer had to wait.
+
+    ``workers=0`` executes ``fn`` inline at pop time (every pop is a stall
+    by definition): the instrumented synchronous reference.
+    """
+
+    def __init__(
+        self,
+        fn: Callable,
+        items: Iterable,
+        workers: int = 2,
+        prefetch: int = 0,
+        stats: Optional[PipelineStats] = None,
+        name: str = "batches",
+    ):
+        self.fn = fn
+        self._items = iter(items)
+        self.workers = max(0, int(workers))
+        self.prefetch = (
+            int(prefetch)
+            if prefetch and prefetch > 0
+            else max(2 * self.workers, self.workers + 1)
+        )
+        self.stats = stats if stats is not None else PipelineStats()
+        self.stats.workers = self.workers
+        self._fifo: deque = deque()
+        self._closed = False
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=self.workers,
+                thread_name_prefix=f"{THREAD_PREFIX}-{name}",
+            )
+            if self.workers
+            else None
+        )
+
+    def _top_up(self) -> None:
+        while self._pool is not None and len(self._fifo) < self.prefetch:
+            try:
+                item = next(self._items)
+            except StopIteration:
+                break
+            self._fifo.append(self._pool.submit(self.fn, item))
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if self._pool is None:  # inline (synchronous reference) mode
+            try:
+                item = next(self._items)
+            except StopIteration:
+                self.close()
+                raise
+            t0 = time.perf_counter()
+            result = self.fn(item)
+            self.stats.note_pop(True, time.perf_counter() - t0, 0)
+            return result
+        self._top_up()
+        if not self._fifo:
+            self.close()
+            raise StopIteration
+        fut = self._fifo.popleft()
+        stalled = not fut.done()
+        t0 = time.perf_counter()
+        try:
+            result = fut.result()
+        except BaseException:
+            self.close()
+            raise
+        waited = time.perf_counter() - t0
+        depth = sum(1 for f in self._fifo if f.done())
+        self.stats.note_pop(stalled, waited, depth)
+        self._top_up()
+        return result
+
+    def close(self) -> None:
+        """Cancel queued work, wait for in-flight items, join every worker.
+
+        Idempotent; the clean-drain path for preemption (the trainer stops
+        consuming at a step boundary and calls this from ``finally``).
+        """
+        if self._closed:
+            return
+        self._closed = True
+        self._items = iter(())
+        for fut in self._fifo:
+            fut.cancel()
+        self._fifo.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True, cancel_futures=True)
+            self._pool = None
+
+    def __enter__(self) -> "OrderedPipeline":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+class PrefetchIterator:
+    """Single background thread draining a sequential ``src`` iterator into
+    a bounded queue of depth ``depth``.
+
+    For sources that cannot be fanned out (a cv2.VideoCapture is stateful:
+    frame N must be decoded before N+1). Order is trivially preserved;
+    source exceptions re-raise at the consumer's pop; :meth:`close` stops
+    the producer promptly even when the consumer abandons the stream
+    mid-iteration.
+    """
+
+    _ITEM, _DONE, _ERROR = 0, 1, 2
+
+    def __init__(
+        self,
+        src: Iterable,
+        depth: int = 2,
+        stats: Optional[PipelineStats] = None,
+        name: str = "stream",
+    ):
+        self._src = iter(src)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self.stats = stats if stats is not None else PipelineStats()
+        self.stats.workers = 1
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"{THREAD_PREFIX}-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, kind, value) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put((kind, value), timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        try:
+            for item in self._src:
+                if not self._put(self._ITEM, item):
+                    return
+            self._put(self._DONE, None)
+        except BaseException as err:  # re-raised at the consumer's pop
+            self._put(self._ERROR, err)
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        stalled = self._q.empty()
+        t0 = time.perf_counter()
+        kind, value = self._q.get()
+        self.stats.note_pop(stalled, time.perf_counter() - t0, self._q.qsize())
+        if kind == self._DONE:
+            self.close()
+            raise StopIteration
+        if kind == self._ERROR:
+            self.close()
+            raise value
+        return value
+
+    def close(self) -> None:
+        """Stop the producer and join it. Idempotent; safe mid-iteration."""
+        if self._finished and not self._thread.is_alive():
+            return
+        self._finished = True
+        self._stop.set()
+        # Unblock a producer stuck in put() by draining whatever is queued.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

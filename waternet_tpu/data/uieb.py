@@ -150,10 +150,19 @@ class UIEBDataset:
 
     def _imread_retry(self, path, retries: int = 2):
         """Decode with retries (transient I/O on network volumes); None on
-        persistent failure — cv2.imread's own contract for corrupt files."""
+        persistent failure — cv2.imread's own contract for corrupt files.
+
+        Runs wherever ``load_pair`` runs — including input-pipeline worker
+        threads — so the ``decode@K`` fault hook lives here: an injected
+        failure consumes one attempt exactly like a real transient error.
+        """
         import cv2
 
+        from waternet_tpu.resilience import faults
+
         for _ in range(1 + retries):
+            if faults.imread_should_fail():
+                continue  # injected decode failure: one attempt consumed
             img = cv2.imread(str(path))
             if img is not None:
                 return img

@@ -6,6 +6,13 @@ so the accelerator idles during every decode and vice versa. Here frames are
 processed in batches with double buffering: while the device runs batch N,
 the host decodes and preprocesses batch N+1 (JAX dispatch is asynchronous, so
 `enhance_async` returns immediately and the host overlaps with device work).
+
+Decode additionally runs ahead on a background thread
+(:class:`waternet_tpu.data.pipeline.PrefetchIterator`, bounded depth): the
+capture is stateful so decode cannot fan out, but a single producer keeps
+decoding while the consumer blocks on the device sync and writes output
+frames — the three stages (decode, enhance, write) all overlap. ``prefetch=0``
+restores the single-thread double-buffered behavior.
 """
 
 from __future__ import annotations
@@ -61,8 +68,21 @@ def _read_batch(cap, batch_size: int, stats: dict | None = None):
     return frames, rgb
 
 
+def _read_batches(cap, batch_size: int, stats: dict):
+    """Generator over (bgr_frames, rgb_array) batches until EOF."""
+    while True:
+        frames, rgb = _read_batch(cap, batch_size, stats)
+        if rgb is None:
+            return
+        yield frames, rgb
+
+
 def enhance_video_stream(
-    engine, cap, batch_size: int = 4, stats: dict | None = None
+    engine,
+    cap,
+    batch_size: int = 4,
+    stats: dict | None = None,
+    prefetch: int = 2,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Yield (original_bgr, enhanced_bgr) frame pairs in order.
 
@@ -71,6 +91,13 @@ def enhance_video_stream(
     are skipped (not treated as EOF — see :func:`_read_batch`); pass a
     ``stats`` dict to receive the counts, and a summary warning is emitted
     at end of stream whenever frames were dropped.
+
+    With ``prefetch > 0`` (default) decode runs on a background producer
+    thread up to ``prefetch`` batches ahead, so it overlaps not just the
+    device compute but also the consumer's sync + frame writing; the
+    producer is joined promptly even when the consumer abandons the stream
+    mid-clip. ``prefetch=0`` decodes inline on the consumer thread (the
+    historical double-buffered behavior).
     """
     import cv2
 
@@ -91,23 +118,34 @@ def enhance_video_stream(
                 stacklevel=3,
             )
 
-    prev_frames, prev_rgb = _read_batch(cap, batch_size, stats)
-    if prev_rgb is None:
-        _finish()
-        return
-    pending = engine.enhance_async(prev_rgb)
+    source = _read_batches(cap, batch_size, stats)
+    if prefetch > 0:
+        from waternet_tpu.data.pipeline import PrefetchIterator
 
-    while True:
-        # Decode the next batch while the device works on `pending`.
-        next_frames, next_rgb = _read_batch(cap, batch_size, stats)
-        from waternet_tpu.utils.tensor import ten2arr
-
-        out = ten2arr(pending)  # sync point for the previous batch
-        if next_rgb is not None:
-            pending = engine.enhance_async(next_rgb)
-        for bgr_in, rgb_out in zip(prev_frames, out):
-            yield bgr_in, cv2.cvtColor(rgb_out, cv2.COLOR_RGB2BGR)
-        if next_rgb is None:
+        source = PrefetchIterator(source, depth=prefetch, name="video")
+    try:
+        got = next(source, None)
+        if got is None:
             _finish()
             return
-        prev_frames = next_frames
+        prev_frames, prev_rgb = got
+        pending = engine.enhance_async(prev_rgb)
+
+        while True:
+            # The next batch decodes while the device works on `pending`
+            # (on the producer thread when prefetching, else inline here).
+            nxt = next(source, None)
+            from waternet_tpu.utils.tensor import ten2arr
+
+            out = ten2arr(pending)  # sync point for the previous batch
+            if nxt is not None:
+                pending = engine.enhance_async(nxt[1])
+            for bgr_in, rgb_out in zip(prev_frames, out):
+                yield bgr_in, cv2.cvtColor(rgb_out, cv2.COLOR_RGB2BGR)
+            if nxt is None:
+                _finish()
+                return
+            prev_frames = nxt[0]
+    finally:
+        if prefetch > 0:
+            source.close()

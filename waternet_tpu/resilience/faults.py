@@ -14,6 +14,12 @@ is a set of one-shot events, each keyed by a deterministic counter:
 * ``truncate_ckpt@K`` — after the K-th (1-based) finalized checkpoint save,
   truncate its largest payload file, simulating a mid-write crash or torn
   volume that the marker protocol alone cannot see.
+* ``decode@K`` — the K-th ``cv2.imread`` *attempt* (1-based, process-global,
+  counted across pipeline worker threads under a lock) reports a decode
+  failure, exercising :meth:`UIEBDataset._imread_retry`'s retry path — and,
+  when enough consecutive attempts are armed to exhaust the retries, the
+  quarantine path — exactly where production hits them: inside the input
+  pipeline's workers.
 
 Plans come from the environment (``WATERNET_FAULTS="nan@3,sigterm@10"``,
 read once by :func:`install_from_env`, which train.py calls) or from tests
@@ -31,15 +37,18 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 from pathlib import Path
 
 _PLAN: "FaultPlan | None" = None
+_IMREAD_CALLS = 0
+_IMREAD_LOCK = threading.Lock()
 
 
 class FaultPlan:
     """One-shot fault events keyed by (kind, ordinal)."""
 
-    KINDS = ("nan", "sigterm", "truncate_ckpt")
+    KINDS = ("nan", "sigterm", "truncate_ckpt", "decode")
 
     def __init__(self, events=()):
         self._pending = set()
@@ -77,8 +86,10 @@ class FaultPlan:
 
 
 def install(plan: FaultPlan | None) -> None:
-    global _PLAN
+    global _PLAN, _IMREAD_CALLS
     _PLAN = plan
+    with _IMREAD_LOCK:
+        _IMREAD_CALLS = 0
 
 
 def clear() -> None:
@@ -126,6 +137,23 @@ def after_train_step(engine, metrics, global_step: int):
     if _PLAN.fire("sigterm", global_step):
         os.kill(os.getpid(), signal.SIGTERM)
     return metrics
+
+
+def imread_should_fail() -> bool:
+    """Hook run before each ``cv2.imread`` attempt in
+    :meth:`waternet_tpu.data.uieb.UIEBDataset._imread_retry`.
+
+    Returns True when this attempt should be treated as a decode failure
+    (kind ``decode``, keyed by a process-global attempt counter guarded by
+    a lock — pipeline workers call this concurrently). With no plan
+    installed this is a single ``is None`` check.
+    """
+    global _IMREAD_CALLS
+    if _PLAN is None:
+        return False
+    with _IMREAD_LOCK:
+        _IMREAD_CALLS += 1
+        return _PLAN.fire("decode", _IMREAD_CALLS)
 
 
 def after_checkpoint_save(path, ordinal: int) -> None:

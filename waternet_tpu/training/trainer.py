@@ -614,8 +614,12 @@ class TrainingEngine:
         ref_p, _ = pad_to_multiple(np.asarray(ref), n_data)
         return raw_p, ref_p, n_real
 
-    def _host_preprocess_batch(self, raw, ref, rng_np=None):
-        """cv2/NumPy path: optional paired augment + per-item transforms."""
+    def _host_preprocess_np(self, raw, ref, rng_np=None):
+        """cv2/NumPy stage of the host-preprocess path: optional paired
+        augment + per-item WB/GC/CLAHE, returned as float32 numpy arrays
+        (x, wb, he, gc, ref) scaled to [0, 1]. Pure host work — the device
+        transfer is split out so pipeline workers can time the two stages
+        separately (and so the transfer can overlap the previous step)."""
         import numpy as np
 
         from waternet_tpu.data.augment import augment_pair_np
@@ -624,10 +628,15 @@ class TrainingEngine:
         if rng_np is not None and self.config.augment:
             raw, ref = augment_pair_np(rng_np, raw, ref)
         wbs, gcs, hes = zip(*(transform_np(f) for f in raw))
-        as_f = lambda arrs: self._to_global(
-            np.stack(list(arrs)).astype(np.float32) / 255.0
-        )
+        as_f = lambda arrs: np.stack(list(arrs)).astype(np.float32) / 255.0
         return as_f(raw), as_f(wbs), as_f(hes), as_f(gcs), as_f(ref)
+
+    def _host_preprocess_batch(self, raw, ref, rng_np=None):
+        """cv2/NumPy path: optional paired augment + per-item transforms."""
+        return tuple(
+            self._to_global(a)
+            for a in self._host_preprocess_np(raw, ref, rng_np)
+        )
 
     # ------------------------------------------------------------------
     # Device-resident dataset cache
@@ -1187,6 +1196,284 @@ class TrainingEngine:
             for k in sums:
                 sums[k] += float(metrics[k])
         return {k: v / max(count, 1) for k, v in sums.items()}
+
+    # ------------------------------------------------------------------
+    # Overlapped input pipeline (waternet_tpu/data/pipeline.py)
+    # ------------------------------------------------------------------
+
+    def _epoch_plan(self, indices, epoch: int, shuffle: bool, start_batch: int = 0):
+        """``[(count, index_chunk)]`` for one epoch — batch composition
+        identical to :func:`waternet_tpu.data.batching.iter_batches` (same
+        Philox stream), but as a work list: ``start_batch`` chunks are
+        skipped WITHOUT loading them (mid-epoch resume), and each entry is
+        an independent work item a pipeline worker can produce out of
+        order."""
+        import numpy as np
+
+        from waternet_tpu.data.batching import epoch_permutation
+
+        if shuffle:
+            order = epoch_permutation(indices, self.config.seed, epoch)
+        else:
+            order = np.array(indices, copy=True)
+        b = self.config.batch_size
+        return [
+            (count, order[s : s + b])
+            for count, s in enumerate(range(0, len(order), b))
+            if count >= start_batch
+        ]
+
+    def _padded_rows(self, n_items: int) -> int:
+        """Rows of ``n_items`` after _pad_batch's data-axis rounding."""
+        n_data = self.mesh.shape[DATA_AXIS]
+        return -(-n_items // n_data) * n_data
+
+    def _plan_augment_states(self, plan, epoch, start_batch=0, start_items=None):
+        """Per-batch host augment RNG states for ``plan``, or None when the
+        host augment stream is unused.
+
+        The synchronous path consumes ONE master stream batch by batch;
+        parallel workers cannot share that. Instead the consumer advances
+        the master here, sequentially and datalessly (augment draw
+        consumption depends only on the PADDED row count — see
+        :func:`waternet_tpu.data.augment.advance_augment_rng`), recording
+        each batch's start state; a worker then clones its batch's state
+        and reproduces the exact draws the synchronous path would have
+        made, in any completion order."""
+        if not (self.config.host_preprocess and self.config.augment):
+            return None
+        import copy
+
+        import numpy as np
+
+        from waternet_tpu.data.augment import advance_augment_rng
+
+        host_rng = np.random.default_rng(self.config.seed + 7 + epoch)
+        b = self.config.batch_size
+        # Skipped-prefix fast-forward: mirrors train_epoch's resume logic
+        # exactly (padded rows, start_items semantics).
+        total = start_batch * b if start_items is None else start_items
+        for k in range(start_batch):
+            n_real = min(b, total - k * b)
+            if n_real <= 0:
+                break
+            advance_augment_rng(host_rng, self._padded_rows(n_real))
+        states = {}
+        for count, chunk in plan:
+            states[count] = copy.deepcopy(host_rng.bit_generator.state)
+            advance_augment_rng(host_rng, self._padded_rows(len(chunk)))
+        return states
+
+    def _pipeline_produce(self, dataset, aug_states, stats, train=True):
+        """Worker function for one batch work item: load pairs, pad,
+        (optionally) host-preprocess with the batch's own cloned RNG, and
+        issue the device transfer — each stage timed into ``stats``. Runs
+        on pipeline worker threads (cv2/NumPy release the GIL; jax
+        transfers are thread-safe and asynchronous); everything here is a
+        pure function of the work item, which is why completion order
+        cannot affect results."""
+        import copy
+        import time as _time
+
+        import numpy as np
+
+        def produce(item):
+            count, chunk = item
+            t0 = _time.perf_counter()
+            pairs = [dataset.load_pair(int(i)) for i in chunk]
+            raw = np.stack([p[0] for p in pairs])
+            ref = np.stack([p[1] for p in pairs])
+            stats.add_stage("load", _time.perf_counter() - t0)
+            raw_p, ref_p, n_real = self._pad_batch(raw, ref)
+            # The payload keeps the HOST uint8 arrays (and, host path, the
+            # batch's RNG state) alongside the prefetched device tensors:
+            # dispatch POPS the device side on first use so the epoch
+            # driver's deferred-fetch `pending` list never pins more than
+            # the in-flight prefetch window in device memory (the payload
+            # of an epoch-long pending list otherwise accumulates every
+            # batch in HBM — fatal for exactly the doesn't-fit-HBM
+            # datasets the streaming path exists for), while the host side
+            # stays rebuildable for the sentinel's rollback-replay.
+            payload = {"raw": raw_p, "ref": ref_p, "n_real": n_real}
+            if self.config.host_preprocess:
+                state = None
+                if train and aug_states is not None:
+                    state = copy.deepcopy(aug_states[count])
+                payload["aug_state"] = state
+                rng_np = None
+                if state is not None:
+                    rng_np = np.random.default_rng(0)
+                    rng_np.bit_generator.state = copy.deepcopy(state)
+                t0 = _time.perf_counter()
+                arrs = self._host_preprocess_np(raw_p, ref_p, rng_np)
+                stats.add_stage("preprocess", _time.perf_counter() - t0)
+                t0 = _time.perf_counter()
+                payload["tensors"] = tuple(self._to_global(a) for a in arrs)
+                stats.add_stage("transfer", _time.perf_counter() - t0)
+                return count, payload
+            t0 = _time.perf_counter()
+            payload["raw_g"] = self._to_global(raw_p)
+            payload["ref_g"] = self._to_global(ref_p)
+            stats.add_stage("transfer", _time.perf_counter() - t0)
+            return count, payload
+
+        return produce
+
+    def _pipeline_tensors(self, payload):
+        """The host-preprocess device tensors for a pipelined payload:
+        the prefetched ones on first dispatch (popped — see
+        _pipeline_produce's memory note), rebuilt deterministically from
+        the host arrays + recorded RNG state on a sentinel replay (the
+        same recompute contract as the synchronous path's dispatch)."""
+        tensors = payload.pop("tensors", None)
+        if tensors is not None:
+            return tensors
+        import copy
+
+        import numpy as np
+
+        rng_np = None
+        if payload.get("aug_state") is not None:
+            rng_np = np.random.default_rng(0)
+            rng_np.bit_generator.state = copy.deepcopy(payload["aug_state"])
+        return tuple(
+            self._to_global(a)
+            for a in self._host_preprocess_np(
+                payload["raw"], payload["ref"], rng_np
+            )
+        )
+
+    def _pipeline_raw_ref(self, payload):
+        """Device uint8 (raw, ref) for a pipelined payload: prefetched on
+        first dispatch (popped), re-transferred from the host arrays on a
+        sentinel replay."""
+        raw_g = payload.pop("raw_g", None)
+        ref_g = payload.pop("ref_g", None)
+        if raw_g is None:
+            raw_g = self._to_global(payload["raw"])
+            ref_g = self._to_global(payload["ref"])
+        return raw_g, ref_g
+
+    def train_epoch_pipelined(
+        self,
+        dataset,
+        indices,
+        epoch: int,
+        *,
+        workers: int = 2,
+        prefetch: int = 0,
+        start_batch: int = 0,
+        start_items: Optional[int] = None,
+        control=None,
+        carry=None,
+    ) -> dict:
+        """Overlapped host-fed epoch: byte-identical to :meth:`train_epoch`
+        over ``dataset.batches(indices, ...)`` (same Philox batch
+        composition, same augment draws, same step programs — pinned in
+        tests/test_pipeline.py), with pair loading, host preprocessing, and
+        the H2D transfer of batch k+1 running in a bounded worker pool
+        while step k executes (docs/PIPELINE.md). Steps always dispatch
+        sequentially on the consumer thread — the pipeline overlaps only
+        the host stages, so the resilience contract (mid-epoch resume via
+        ``start_batch``, sentinel rollback-replay, preemption drain at step
+        boundaries) is inherited from :meth:`_drive_train_epoch` unchanged.
+
+        ``workers=0`` runs the identical code path inline (the instrumented
+        synchronous reference bench.py A/Bs against). Returned metrics gain
+        ``pipeline_*`` instrumentation: stall pct (steps that waited on the
+        queue), per-stage ms, queue depth, worker count.
+        """
+        from waternet_tpu.data.pipeline import OrderedPipeline, PipelineStats
+
+        plan = self._epoch_plan(
+            indices, epoch, self.config.shuffle, start_batch
+        )
+        aug_states = self._plan_augment_states(
+            plan, epoch, start_batch, start_items
+        )
+        stats = PipelineStats()
+        base_rng = jax.random.PRNGKey(self.config.seed + 1)
+
+        def dispatch(count, payload):
+            with stats.stage("step"):
+                if self.config.host_preprocess:
+                    self.state, metrics = self.train_step_pre(
+                        self.state,
+                        *self._pipeline_tensors(payload),
+                        payload["n_real"],
+                    )
+                else:
+                    rng = jax.random.fold_in(
+                        jax.random.fold_in(base_rng, epoch), count
+                    )
+                    raw_g, ref_g = self._pipeline_raw_ref(payload)
+                    self.state, metrics = self.train_step(
+                        self.state, raw_g, ref_g, rng, payload["n_real"]
+                    )
+            return self._post_step(metrics)
+
+        pipe = OrderedPipeline(
+            self._pipeline_produce(dataset, aug_states, stats),
+            plan,
+            workers=workers,
+            prefetch=prefetch,
+            stats=stats,
+            name="train",
+        )
+        try:
+            out = self._drive_train_epoch(
+                pipe, dispatch, control=control, carry=carry
+            )
+        finally:
+            pipe.close()  # preemption/error drain: join workers, drop queue
+        out.update(stats.metrics())
+        return out
+
+    def eval_epoch_pipelined(
+        self, dataset, indices, *, workers: int = 2, prefetch: int = 0
+    ) -> dict:
+        """Pipelined counterpart of :meth:`eval_epoch` (no shuffle, no
+        augmentation): validation epochs stop serializing load/preprocess
+        against the device. Metric values are identical to
+        ``eval_epoch(dataset.batches(indices, shuffle=False))``; the dict
+        additionally carries the ``pipeline_*`` instrumentation keys."""
+        from waternet_tpu.data.pipeline import OrderedPipeline, PipelineStats
+
+        plan = self._epoch_plan(indices, epoch=0, shuffle=False)
+        stats = PipelineStats()
+        pending = []
+        pipe = OrderedPipeline(
+            self._pipeline_produce(dataset, None, stats, train=False),
+            plan,
+            workers=workers,
+            prefetch=prefetch,
+            stats=stats,
+            name="eval",
+        )
+        try:
+            for _count, payload in pipe:
+                with stats.stage("step"):
+                    if self.config.host_preprocess:
+                        m = self.eval_step_pre(
+                            self.state,
+                            *self._pipeline_tensors(payload),
+                            payload["n_real"],
+                        )
+                    else:
+                        raw_g, ref_g = self._pipeline_raw_ref(payload)
+                        m = self.eval_step(
+                            self.state, raw_g, ref_g, payload["n_real"]
+                        )
+                pending.append(m)
+        finally:
+            pipe.close()
+        sums = {k: 0.0 for k in VAL_METRICS_NAMES}
+        for metrics in pending:
+            for k in sums:
+                sums[k] += float(metrics[k])
+        out = {k: v / max(len(pending), 1) for k, v in sums.items()}
+        out.update(stats.metrics())
+        return out
 
     # ------------------------------------------------------------------
     # Checkpoint / resume (full state: params + Adam moments + step)
