@@ -86,6 +86,14 @@ def _image_shape(path) -> "tuple[int, int, int] | None":
                         continue
                     if m == 0xD9:  # EOI before any SOF
                         return None
+                    if m == 0xDA:
+                        # SOS before any SOF: what follows is
+                        # entropy-coded data where 0xFF bytes are
+                        # stuffing/restart markers, not a marker chain —
+                        # walking on can "find" a fake SOF and return a
+                        # garbage shape. Give up; the caller falls back
+                        # to a full decode.
+                        return None
                     seg = fh.read(2)
                     if len(seg) < 2:
                         return None

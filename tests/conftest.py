@@ -69,6 +69,76 @@ def _no_pipeline_worker_leak():
     assert not leaked, f"leaked pipeline worker threads: {leaked}"
 
 
+class CompileSentinel:
+    """Dynamic companion of jaxlint (docs/LINT.md): snapshot the per-jit
+    executable-cache sizes of armed step functions and fail if any of
+    them compiles again afterwards. The static rules (R004) catch
+    recompile hazards that are visible in the source; this catches the
+    ones that aren't — a shape/dtype drifting between batches, a weak
+    static argument, a donation mismatch — by watching ``jax.jit``'s own
+    cache grow mid-epoch. Arm AFTER the warm-up step (the first call
+    compiles by design), run the epoch, then ``check()``.
+    """
+
+    def __init__(self):
+        self._armed = {}
+
+    def arm(self, **fns) -> None:
+        for name, fn in fns.items():
+            if not hasattr(fn, "_cache_size"):
+                pytest.skip(
+                    "this jax version's jit wrapper has no _cache_size()"
+                )
+            self._armed[name] = (fn, fn._cache_size())
+
+    def arm_engine(self, engine) -> None:
+        """Arm every already-compiled step function of a TrainingEngine
+        (cache size 0 means never called — arming it would only assert
+        it stays unused, which is fine too). Skips the test, like
+        :meth:`arm`, when this jax build exposes no cache introspection
+        at all — a vacuously-passing check would be worse than none."""
+        armed_any = False
+        for attr in (
+            "train_step", "train_step_pre", "train_step_cached",
+            "train_step_cached_pre", "train_step_cached_pre_vggref",
+            "eval_step", "eval_step_pre", "eval_step_cached",
+            "eval_step_cached_pre", "eval_step_cached_pre_vggref",
+        ):
+            fn = getattr(engine, attr, None)
+            if fn is not None and hasattr(fn, "_cache_size"):
+                self._armed[attr] = (fn, fn._cache_size())
+                armed_any = True
+        if not armed_any:
+            pytest.skip(
+                "this jax version's jit wrapper has no _cache_size()"
+            )
+
+    def counts(self) -> dict:
+        return {
+            name: (before, fn._cache_size())
+            for name, (fn, before) in self._armed.items()
+        }
+
+    def check(self) -> None:
+        grew = {
+            name: f"{before} -> {after}"
+            for name, (before, after) in self.counts().items()
+            if after > before
+        }
+        assert not grew, (
+            f"step functions recompiled mid-epoch: {grew} — every epoch "
+            "after warm-up must reuse the compiled executables (jaxlint "
+            "R004 catches the static causes; this sentinel caught a "
+            "dynamic one: shape/dtype drift or a weak static argument)"
+        )
+
+
+@pytest.fixture
+def compile_sentinel():
+    """Per-test :class:`CompileSentinel` (see docs/LINT.md)."""
+    return CompileSentinel()
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,32 @@
+"""R001 negative: donation with same-statement rebinding and owned buffers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def double(x):
+    return x * 2
+
+
+step = jax.jit(double, donate_argnums=(0,))
+
+
+def rebind_same_statement(x):
+    x = step(x)  # donated name rebound by the call's own statement
+    return x + 1
+
+
+class Engine:
+    def __init__(self):
+        self.step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+        self.state = self._restore()
+
+    def _restore(self):
+        host = np.zeros((4,), np.float32)
+        put = jax.device_put(host)
+        return jax.tree.map(jnp.copy, put)  # ownership copy severs the alias
+
+    def advance(self):
+        self.state = self.step(self.state)  # rebound in the same statement
+        return self.state

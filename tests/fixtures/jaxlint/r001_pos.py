@@ -1,0 +1,33 @@
+"""R001 positive: donated buffers read after the call / aliasing host memory."""
+
+import jax
+import numpy as np
+
+
+def double(x):
+    return x * 2
+
+
+step = jax.jit(double, donate_argnums=(0,))
+
+
+def read_after_donation(x):
+    y = step(x)
+    return x + y  # x was donated: this read sees freed/overwritten memory
+
+
+class Engine:
+    """The PR-1 _own_device_state corruption class, in miniature."""
+
+    def __init__(self):
+        self.step = jax.jit(lambda s: s + 1, donate_argnums=(0,))
+        self.state = self._restore()
+
+    def _restore(self):
+        host = np.zeros((4,), np.float32)
+        return jax.device_put(host)  # zero-copy borrow of `host`
+
+    def advance(self):
+        new = self.step(self.state)  # donates a borrowed buffer
+        self.state = new
+        return new

@@ -1,0 +1,43 @@
+"""R002 negative: correct key discipline — split, fold_in, exclusive arms."""
+
+import jax
+import numpy as np
+
+
+def split_per_use(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    return a + b
+
+
+def exclusive_branches(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    else:
+        return jax.random.uniform(key, (2,))
+
+
+def fold_in_per_iteration(base, n):
+    # The trainer's idiom: fold_in derives a fresh stream per (epoch, batch).
+    outs = []
+    for i in range(n):
+        k = jax.random.fold_in(base, i)
+        outs.append(jax.random.normal(k, (2,)))
+    return outs
+
+
+def carried_key(key):
+    # The canonical carried-key idiom: the OLD key is consumed by split,
+    # the rebound NEW key is consumed exactly once afterwards.
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (2,))
+    b = jax.random.normal(key, (2,))
+    return a + b
+
+
+def numpy_rng_is_not_a_key(rng, items):
+    # A numpy Generator named `rng` must not be mistaken for a jax key.
+    first = rng.permutation(len(items))
+    second = rng.permutation(len(items))
+    return np.concatenate([first, second])

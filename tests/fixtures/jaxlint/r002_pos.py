@@ -1,0 +1,23 @@
+"""R002 positive: PRNG key reuse — double consumption and loop reuse."""
+
+import jax
+
+
+def double_consume(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))  # same key, correlated draws
+    return a + b
+
+
+def split_then_reuse(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.normal(key, (2,))  # original key reused after split
+    return a + b + k2.sum()
+
+
+def loop_reuse(key, n):
+    out = []
+    for _ in range(n):
+        out.append(jax.random.bernoulli(key))  # identical draw every pass
+    return out

@@ -1,0 +1,34 @@
+"""R003 negative: the deferred-fetch discipline — dispatch loop stays
+async, scalars are read once after the loop."""
+
+import jax
+
+
+step = jax.jit(lambda s, x: (s + x, {"loss": (s * x).sum()}))
+
+
+def epoch_deferred_fetch(state, batches):
+    pending = []
+    for b in batches:
+        state, m = step(state, b)
+        pending.append(m)  # device values, no sync
+    # The fetch loop dispatches nothing, so syncing here is sanctioned.
+    losses = [float(m["loss"]) for m in pending]
+    return state, losses
+
+
+def fetch_only_loop(pending):
+    total = 0.0
+    for m in pending:
+        total += float(m["loss"])  # no dispatch in this loop: fine
+    return total
+
+
+def host_casts_beside_dispatch(state, batches, scale):
+    # Plain Python casts in a dispatching loop are not syncs: the
+    # arguments never derive from a jitted call's result.
+    pending = []
+    for i, b in enumerate(batches):
+        state, m = step(state, b * float(scale))
+        pending.append((int(i), m))
+    return state, pending

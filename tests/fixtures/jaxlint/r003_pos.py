@@ -1,0 +1,23 @@
+"""R003 positive: host syncs inside a loop that dispatches jitted work."""
+
+import jax
+import numpy as np
+
+
+step = jax.jit(lambda s, x: (s + x, {"loss": (s * x).sum()}))
+
+
+def epoch_with_per_step_fetch(state, batches):
+    losses = []
+    for b in batches:
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))  # forces a sync every step
+    return state, losses
+
+
+def epoch_with_blocking(state, batches):
+    for b in batches:
+        state, m = step(state, b)
+        jax.block_until_ready(state)  # drains the device queue per step
+        np.asarray(m["loss"])  # synchronous D2H copy per step
+    return state

@@ -1,0 +1,35 @@
+"""R004 negative: static branches, hoisted jit, hashable statics."""
+
+import jax
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(1,))
+def static_branch(x, mode):
+    if mode == "fast":  # `mode` is static: branching is the intended use
+        return x * 2
+    return x
+
+
+@jax.jit
+def none_and_shape_checks(x, bias):
+    if bias is None:  # `is None` is a Python-level structure check
+        bias = 0.0
+    if x.shape[0] > 4:  # shapes are static under tracing
+        return x + bias
+    return x - bias
+
+
+scale = jax.jit(lambda x, opts: x * opts[0], static_argnums=(1,))
+
+
+def hashable_static(x):
+    return scale(x, (2, 3))  # tuple: hashable cache key
+
+
+def jit_hoisted(fn, xs):
+    jitted = jax.jit(fn)
+    out = []
+    for x in xs:
+        out.append(jitted(x))
+    return out

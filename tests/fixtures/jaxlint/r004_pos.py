@@ -1,0 +1,25 @@
+"""R004 positive: recompile hazards — traced branch, jit-in-loop,
+unhashable static argument."""
+
+import jax
+
+
+@jax.jit
+def traced_branch(x):
+    if x > 0:  # Python branch on a traced value
+        return x
+    return -x
+
+
+def jit_per_iteration(fn, xs):
+    y = None
+    for x in xs:
+        y = jax.jit(fn)(x)  # fresh callable (and cache) every pass
+    return y
+
+
+scale = jax.jit(lambda x, opts: x * opts[0], static_argnums=(1,))
+
+
+def unhashable_static(x):
+    return scale(x, [2, 3])  # list literal at a static position

@@ -1,0 +1,20 @@
+"""R005 negative: locally-created containers are trace-local and fine."""
+
+import jax
+
+
+@jax.jit
+def local_containers(x):
+    metrics = {}
+    metrics["double"] = x * 2  # local dict: dies with the trace
+    parts = []
+    parts.append(x)  # local list: same
+    total = metrics["double"] + parts[0]
+    return {"total": total}
+
+
+def build_and_store(engine, x):
+    # Storing OUTSIDE the jitted function is the sanctioned pattern.
+    y = local_containers(x)
+    engine.last = y
+    return y

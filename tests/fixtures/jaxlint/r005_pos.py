@@ -1,0 +1,25 @@
+"""R005 positive: traced values escaping the trace via self/globals/closures."""
+
+import jax
+
+_LOG = []
+_CACHE = {}
+
+
+@jax.jit
+def leak_into_module_state(x):
+    y = x * 2
+    _LOG.append(y)  # closure append: runs at trace time only
+    _CACHE["last"] = y  # subscript store into module state
+    return y
+
+
+def make_step(holder):
+    @jax.jit
+    def step(x):
+        global _LAST
+        _LAST = x  # global store inside the trace
+        holder.value = x  # attribute store on a closure object
+        return x + 1
+
+    return step

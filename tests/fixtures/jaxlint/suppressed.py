@@ -1,0 +1,23 @@
+"""Suppression fixture: real findings silenced by `# jaxlint: disable=`
+comments — both same-line and disable-next forms, with justifications."""
+
+import jax
+
+
+step = jax.jit(lambda s, x: (s + x, {"loss": (s * x).sum()}))
+
+
+def profiled_epoch(state, batches):
+    losses = []
+    for b in batches:
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))  # jaxlint: disable=R003 profiling run: per-step latency IS the measurement
+    return state, losses
+
+
+def timed_epoch(state, batches):
+    for b in batches:
+        state, m = step(state, b)
+        # jaxlint: disable-next=R003 wall-clock timing needs the queue drained per step
+        jax.block_until_ready(state)
+    return state

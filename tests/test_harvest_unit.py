@@ -1,0 +1,50 @@
+"""Unit tests for tools/harvest_convergence.py's log parsing (pure host:
+no accelerator, no jax — the tool is a regex over train.py's stdout)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import harvest_convergence  # noqa: E402
+
+
+def _epoch_lines(epoch, mse, ssim=0.91, psnr=27.6, perc=1.23):
+    return (
+        f"Epoch {epoch}/400 [train 87.2s + val 1.3s, 7.3 img/s]\n"
+        f"    Train || mse: 123   ssim: 0.9   psnr: 20   "
+        f"perceptual_loss: 1.5   loss: 124\n"
+        f"    Val   || mse: {mse}   ssim: {ssim}   psnr: {psnr}   "
+        f"perceptual_loss: {perc}\n"
+    )
+
+
+def test_parse_log_plain_and_exponent_mse():
+    """The mse field must admit negative exponents (train.py prints %.3g,
+    so small values render as 9.5e-05) — the old regex class [\\d.e+]+
+    silently dropped every such epoch line."""
+    text = (
+        _epoch_lines(1, "123")
+        + _epoch_lines(2, "9.5e-05", perc="2.1e-03")
+        + _epoch_lines(3, "1.2e+02")
+    )
+    rows = harvest_convergence.parse_log(text)
+    assert [r["epoch"] for r in rows] == [1, 2, 3]
+    assert rows[0]["mse"] == 123.0
+    assert rows[1]["mse"] == 9.5e-05
+    assert rows[1]["perceptual"] == 2.1e-03
+    assert rows[2]["mse"] == 1.2e02
+    assert all(r["train_s"] == 87.2 for r in rows)
+
+
+def test_parse_log_ignores_unrelated_lines():
+    text = (
+        "[tpu_session] stage: init\n"
+        + _epoch_lines(7, "4.56e-01")
+        + "checkpointed at output/run/ckpt-7\n"
+    )
+    rows = harvest_convergence.parse_log(text)
+    assert len(rows) == 1
+    assert rows[0]["epoch"] == 7
+    assert rows[0]["mse"] == 0.456

@@ -1,0 +1,240 @@
+"""jaxlint: the repo-wide hazard gate, per-rule fixture corpus, the
+suppression contract, the CLI surface, and the compile-count sentinel.
+
+``test_repo_clean`` is the tier-1 gate the tentpole exists for: the
+production tree (package + CLIs) must carry zero unsuppressed findings,
+so every new donation/RNG/sync/recompile/tracer hazard either gets fixed
+or argued for in a suppression comment that reviewers can see.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from waternet_tpu.analysis import (
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from waternet_tpu.analysis.cli import main as jaxlint_main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "jaxlint"
+#: The acceptance-criteria lint surface: the package and every CLI.
+LINT_TARGETS = ("waternet_tpu", "train.py", "score.py", "inference.py", "bench.py")
+ALL_RULES = ("R001", "R002", "R003", "R004", "R005")
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide gate (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_clean():
+    findings, files = lint_paths([REPO / t for t in LINT_TARGETS])
+    unsuppressed = [f for f in findings if not f.suppressed]
+    assert files >= 40, f"lint surface shrank unexpectedly: {files} files"
+    assert not unsuppressed, "unsuppressed jaxlint findings:\n" + "\n".join(
+        f.render() for f in unsuppressed
+    )
+
+
+def test_repo_carries_justified_suppressions():
+    # The suppressions on the existing tree are part of the contract:
+    # they document deliberate syncs (cache builds, benchmark timing).
+    findings, _ = lint_paths([REPO / t for t in LINT_TARGETS])
+    assert any(f.suppressed for f in findings)
+
+
+def test_registry_has_all_five_rules():
+    assert set(ALL_RULES) <= set(RULES)
+    for rid in ALL_RULES:
+        assert RULES[rid].name and RULES[rid].description
+
+
+# ---------------------------------------------------------------------------
+# Fixture corpus: each rule fires on its positive, stays quiet on its
+# negative, and fires ONLY its own rule on the positive.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_rule_fires_on_positive_fixture(rule):
+    findings = lint_file(FIXTURES / f"{rule.lower()}_pos.py")
+    fired = {f.rule for f in findings if not f.suppressed}
+    assert fired == {rule}, (
+        f"expected exactly {{{rule}}} on the positive fixture, got {fired}"
+    )
+    assert len([f for f in findings if f.rule == rule]) >= 2
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_rule_quiet_on_negative_fixture(rule):
+    findings = lint_file(FIXTURES / f"{rule.lower()}_neg.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_suppression_comments_silence_but_are_counted():
+    findings = lint_file(FIXTURES / "suppressed.py")
+    assert len(findings) == 2  # same-line and disable-next forms
+    assert all(f.suppressed for f in findings)
+    assert {f.rule for f in findings} == {"R003"}
+
+
+def test_rule_filter_restricts_output():
+    findings = lint_file(FIXTURES / "r003_pos.py", rules=["R001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# The PR-1 regression pin: reverting the _own_device_state ownership copy
+# must light up R001 at the trainer's donation sites.
+# ---------------------------------------------------------------------------
+
+
+def test_r001_fires_when_ownership_copy_reverted():
+    src = (REPO / "waternet_tpu" / "training" / "trainer.py").read_text()
+    marker = "owned = jax.tree.map(jnp.copy, put)"
+    assert marker in src, "_own_device_state ownership copy moved; update test"
+    reverted = src.replace(marker, "owned = put")
+    fired = [
+        f
+        for f in lint_source(reverted, "trainer.py")
+        if f.rule == "R001" and not f.suppressed
+    ]
+    assert fired, "R001 must fire when the ownership copy is reverted"
+    assert any("_own_device_state" in f.message for f in fired)
+    assert any("train_step" in f.message for f in fired)
+    # ... and the real tree is clean (the copy severs the alias).
+    clean = [
+        f
+        for f in lint_source(src, "trainer.py")
+        if f.rule == "R001" and not f.suppressed
+    ]
+    assert clean == [], "\n".join(f.render() for f in clean)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_json(capsys):
+    rc = jaxlint_main([str(FIXTURES / "r003_pos.py"), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["unsuppressed"] >= 1
+    assert payload["summary"]["files_scanned"] == 1
+    assert set(ALL_RULES) <= set(payload["rules"])
+    assert all(
+        {"rule", "path", "line", "col", "message", "suppressed"}
+        <= set(f)
+        for f in payload["findings"]
+    )
+
+    assert jaxlint_main([str(FIXTURES / "r003_neg.py")]) == 0
+    capsys.readouterr()
+    # Suppressed-only file is clean (exit 0) but the summary reports it.
+    rc = jaxlint_main([str(FIXTURES / "suppressed.py"), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["summary"]["suppressed"] == 2
+
+
+def test_cli_usage_errors(capsys, tmp_path):
+    assert jaxlint_main([]) == 2  # no paths
+    assert jaxlint_main([str(FIXTURES), "--rules", "R999"]) == 2
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    assert jaxlint_main([str(bad)]) == 2
+    assert jaxlint_main([str(tmp_path / "missing.py")]) == 2
+    capsys.readouterr()
+    assert jaxlint_main(["--list-rules", "."]) == 0
+    out = capsys.readouterr().out
+    for rid in ALL_RULES:
+        assert rid in out
+
+
+def test_cli_directory_scan_matches_fixture_count(capsys):
+    rc = jaxlint_main([str(FIXTURES), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["files_scanned"] == 11
+    fired = {f["rule"] for f in payload["findings"]}
+    assert set(ALL_RULES) == fired
+
+
+def test_docs_cover_every_rule():
+    doc = (REPO / "docs" / "LINT.md").read_text()
+    for rid, rule in RULES.items():
+        assert rid in doc, f"docs/LINT.md missing {rid}"
+        assert rule.name in doc, f"docs/LINT.md missing rule name {rule.name}"
+
+
+# ---------------------------------------------------------------------------
+# Compile-count sentinel (the dynamic companion, docs/LINT.md)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_engine():
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    cfg = TrainConfig(
+        batch_size=8,
+        im_height=16,
+        im_width=16,
+        precision="fp32",
+        perceptual_weight=0.0,  # skip VGG: keeps the compile trivial
+        augment=True,
+        shuffle=False,
+    )
+    return TrainingEngine(cfg)
+
+
+def _batches(n, batch=8, hw=16, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        raw = rng.integers(0, 256, (batch, hw, hw, 3), dtype=np.uint8)
+        ref = rng.integers(0, 256, (batch, hw, hw, 3), dtype=np.uint8)
+        yield raw, ref
+
+
+def test_compile_sentinel_epoch_is_recompile_free(compile_sentinel):
+    engine = _tiny_engine()
+    engine.train_epoch(_batches(1), epoch=0)  # warm-up: compiles once
+    compile_sentinel.arm_engine(engine)
+    engine.train_epoch(_batches(3, seed=1), epoch=1)
+    compile_sentinel.check()
+    before, after = compile_sentinel.counts()["train_step"]
+    assert before == after == 1
+
+
+def test_compile_sentinel_catches_a_recompile(compile_sentinel):
+    engine = _tiny_engine()
+    engine.train_epoch(_batches(1), epoch=0)
+    compile_sentinel.arm(train_step=engine.train_step)
+    # A drifting batch shape is exactly the hazard class the sentinel
+    # exists for: the step silently compiles a second executable.
+    engine.train_epoch(_batches(1, batch=16), epoch=1)
+    with pytest.raises(AssertionError, match="recompiled mid-epoch"):
+        compile_sentinel.check()
+
+
+@pytest.mark.slow
+def test_compile_sentinel_pipelined_and_eval_epochs(compile_sentinel):
+    """Whole-path dynamic check: the pipelined train epoch and the eval
+    epoch reuse the warm executables too (slow: extra engine compiles)."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    engine = _tiny_engine()
+    ds = SyntheticPairs(16, 16, 16)
+    idx = np.arange(16)
+    engine.train_epoch_pipelined(ds, idx, epoch=0, workers=2)
+    engine.eval_epoch(ds.batches(idx, 8, shuffle=False))
+    compile_sentinel.arm_engine(engine)
+    engine.train_epoch_pipelined(ds, idx, epoch=1, workers=2)
+    engine.eval_epoch(ds.batches(idx, 8, shuffle=False))
+    compile_sentinel.check()

@@ -132,6 +132,27 @@ def test_image_shape_header_parsers(tmp_path, rng):
     assert cli._image_shape(tmp_path / "missing.png") is None
 
 
+def test_image_shape_stops_at_sos(tmp_path):
+    """A JPEG whose marker chain reaches SOS without any SOF must return
+    None (full-decode fallback), NOT a shape scraped from entropy-coded
+    data: past SOS, 0xFF bytes are stuffing/restart markers, and a naive
+    walk can land on a fake SOF with garbage dimensions."""
+    import score as cli
+
+    # SOI, APP0 (minimal), SOS (no SOF anywhere), then entropy bytes that
+    # contain a forged FF C0 "SOF0" carrying an absurd 257x514 "size".
+    fake_sof = b"\xff\xc0\x00\x11\x08" + (257).to_bytes(2, "big") + (514).to_bytes(2, "big")
+    data = (
+        b"\xff\xd8"  # SOI
+        + b"\xff\xe0\x00\x04\x4a\x46"  # APP0, len 4
+        + b"\xff\xda\x00\x08\x01\x01\x00\x00\x3f\x00"  # SOS, len 8
+        + b"\x12\x34" + fake_sof + b"\x56\x78"  # entropy-coded junk
+    )
+    f = tmp_path / "sos_first.jpg"
+    f.write_bytes(data)
+    assert cli._image_shape(f) is None
+
+
 def test_nr_native_single_decode(weights_file, tmp_path, rng, monkeypatch):
     """Native-resolution NR scoring decodes each image exactly ONCE: pass 1
     groups by header-parsed shape (the previous implementation cv2.imread'd

@@ -21,8 +21,10 @@ import re
 def parse_log(text: str):
     rows = []
     pat = re.compile(
+        # mse must admit negative exponents (9.5e-01) exactly like the
+        # perceptual field below — [\d.e+]+ silently dropped such epochs.
         r"Epoch (\d+)/\d+ \[train ([\d.]+)s.*?\n"
-        r".*?\n\s+Val\s+\|\| mse: ([\d.e+]+)\s+ssim: ([\d.]+)\s+"
+        r".*?\n\s+Val\s+\|\| mse: ([\d.e+-]+)\s+ssim: ([\d.]+)\s+"
         r"psnr: ([\d.]+)\s+perceptual_loss: ([\d.e+-]+)"
     )
     for m in pat.finditer(text):

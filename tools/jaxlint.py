@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""jaxlint — JAX-hazard static analysis over this repo (docs/LINT.md).
+
+Thin launcher for :mod:`waternet_tpu.analysis.cli` that works from a
+source checkout without installation (the ``jaxlint`` console entry in
+pyproject.toml is the installed form). Typical invocations::
+
+    python tools/jaxlint.py waternet_tpu train.py score.py inference.py bench.py
+    python tools/jaxlint.py --json waternet_tpu/training/trainer.py
+    python tools/jaxlint.py --list-rules
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/parse error.
+"""
+
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from waternet_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,20 +93,23 @@ def main():
         _, no_vgg = _compile_step(batch, hw, perceptual_weight=0.0)
 
         x = jnp.zeros((batch, hw, hw, 3), jnp.float32)
+        # Compiling per loop iteration is this tool's entire purpose:
+        # each batch size is lowered once to read XLA's cost analysis,
+        # nothing is ever executed twice.
         vgg_fwd = _cost(
-            jax.jit(
+            jax.jit(  # jaxlint: disable=R004 one compile per config is the point of the decomposition
                 lambda v: engine.vgg.apply(engine.vgg_params, v)
             ).lower(x).compile()
         )
         model_fwd = _cost(
-            jax.jit(
+            jax.jit(  # jaxlint: disable=R004 one compile per config is the point of the decomposition
                 lambda p, a: engine.model.apply(p, a, a, a, a)
             ).lower(engine.state.params, x).compile()
         )
         from waternet_tpu.training.metrics import psnr, ssim
 
         metrics_cost = _cost(
-            jax.jit(
+            jax.jit(  # jaxlint: disable=R004 one compile per config is the point of the decomposition
                 lambda a, b: (ssim(a, b), psnr(a, b, data_range=1.0))
             ).lower(x, x).compile()
         )

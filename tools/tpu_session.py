@@ -370,7 +370,7 @@ def stage_profile(trace_dir: Path, hw: int = 112, batch: int = 16):
     trace_dir.mkdir(parents=True, exist_ok=True)
     with jax.profiler.trace(str(trace_dir)):
         for _ in range(3):
-            state, m = engine.train_step(state, raw_d, ref_d, rng, n_real)
+            state, m = engine.train_step(state, raw_d, ref_d, rng, n_real)  # jaxlint: disable=R002 profiler trace: a fixed key replays a fixed program
         jax.block_until_ready(m["loss"])
     n_files = sum(1 for _ in trace_dir.rglob("*") if _.is_file())
     return {"trace_dir": str(trace_dir), "trace_files": n_files}

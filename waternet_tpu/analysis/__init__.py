@@ -1,0 +1,69 @@
+"""jaxlint — static analysis for JAX-specific hazards (docs/LINT.md).
+
+Pure-AST: linting never imports the linted code, so it runs anywhere (no
+accelerator, no jax session) and is safe inside the tier-1 budget. The
+rules encode invariants the repo otherwise enforces only by convention
+or by expensive dynamic tests:
+
+======  =====================  ==================================================
+R001    donation-after-use     donated buffer read after the call / aliases host
+R002    rng-key-reuse          PRNG key consumed twice without split/fold_in
+R003    host-sync-in-hot-loop  .item()/float()/np.asarray in a dispatching loop
+R004    recompile-hazard       unhashable statics, jit-in-loop, traced branches
+R005    tracer-leak            traced values stored into self/globals/closures
+======  =====================  ==================================================
+
+Suppress a deliberate pattern with ``# jaxlint: disable=R00x <why>`` on
+the line (or ``disable-next=`` on the line above); the justification text
+is free-form and strongly encouraged. ``tests/test_jaxlint.py::
+test_repo_clean`` asserts zero unsuppressed findings over the package and
+the CLIs, so every new hazard is either fixed or visibly argued for.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Optional
+
+from waternet_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    ModuleModel,
+    collect_py_files,
+    is_suppressed,
+    suppressions,
+)
+from waternet_tpu.analysis.registry import RULES, run_rules  # noqa: F401
+import waternet_tpu.analysis.rules  # noqa: F401  (registers the rules)
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Iterable[str]] = None,
+) -> list:
+    """Lint one module's source text; returns findings with suppression
+    state resolved. Raises ``SyntaxError`` when the source doesn't parse
+    (the CLI maps that to exit code 2)."""
+    tree = ast.parse(source, filename=str(path))
+    model = ModuleModel(path, source, tree)
+    findings = run_rules(model, rules)
+    supp = suppressions(source)
+    for f in findings:
+        f.suppressed = is_suppressed(f, supp)
+    return findings
+
+
+def lint_file(path, rules: Optional[Iterable[str]] = None) -> list:
+    return lint_source(
+        Path(path).read_text(encoding="utf-8"), str(path), rules
+    )
+
+
+def lint_paths(paths: Iterable, rules: Optional[Iterable[str]] = None):
+    """Lint files/directories; returns ``(findings, files_scanned)``."""
+    files = collect_py_files(paths)
+    findings = []
+    for f in files:
+        findings.extend(lint_file(f, rules))
+    return findings, len(files)

@@ -1,0 +1,95 @@
+"""jaxlint CLI (``tools/jaxlint.py`` wrapper / ``jaxlint`` console entry).
+
+Exit codes follow linter convention: 0 clean (suppressed findings are
+clean), 1 unsuppressed findings, 2 usage or parse error. ``--json``
+emits the machine rendering on stdout for CI consumption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from waternet_tpu.analysis import lint_file
+from waternet_tpu.analysis.core import collect_py_files
+from waternet_tpu.analysis.registry import RULES
+from waternet_tpu.analysis.report import render_json, render_text
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="jaxlint",
+        description=(
+            "Static analysis for JAX-specific hazards: buffer donation, "
+            "PRNG key reuse, host syncs in hot loops, recompile hazards, "
+            "tracer leaks (docs/LINT.md)."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="Python files and/or directories (searched recursively)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.add_argument(
+        "--rules",
+        type=str,
+        default=None,
+        metavar="R001,R003",
+        help="run only these rules (default: all registered rules)",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print suppressed findings in the text rendering",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = parse_args(argv)
+    if args.list_rules:
+        for rid, rule in sorted(RULES.items()):
+            print(f"{rid}  {rule.name}: {rule.description}")
+        return 0
+    if not args.paths:
+        print("jaxlint: no paths given (see --help)", file=sys.stderr)
+        return 2
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(
+                f"jaxlint: unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(RULES))})",
+                file=sys.stderr,
+            )
+            return 2
+    try:
+        files = collect_py_files(args.paths)
+    except FileNotFoundError as err:
+        print(str(err), file=sys.stderr)
+        return 2
+    findings = []
+    for f in files:
+        try:
+            findings.extend(lint_file(f, rules))
+        except SyntaxError as err:
+            print(f"jaxlint: cannot parse {f}: {err}", file=sys.stderr)
+            return 2
+    if args.json:
+        print(render_json(findings, len(files)))
+    else:
+        print(render_text(findings, len(files), args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,433 @@
+"""Shared AST infrastructure for the jaxlint rules (docs/LINT.md).
+
+The linter is a pure-AST pass — no imports of the linted code, no jax at
+lint time — so it can run over accelerator-only modules on any host. The
+machinery here is what every rule needs:
+
+* :class:`Finding` — one diagnostic, with suppression state;
+* :func:`suppressions` — ``# jaxlint: disable=R00x`` comment parsing
+  (tokenize-based, so a ``#`` inside a string literal never counts);
+* :class:`ModuleModel` — a per-file semantic model: parent links, import
+  alias resolution (``jnp`` -> ``jax.numpy``), and a registry of
+  jit-wrapped callables with their ``donate_argnums`` / ``static_argnums``
+  metadata, resolved across the idioms this repo actually uses
+  (``self.step = jax.jit(fn, ...)`` in a builder method, ``@jax.jit`` and
+  ``@partial(jax.jit, ...)`` decorators, module-level wrapping).
+
+Everything is intentionally flow-light: rules prefer missing a hazard to
+crying wolf, because tier-1 asserts the tree is clean and a noisy rule
+would be suppressed into uselessness within a PR or two.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+#: Canonical dotted names that produce a jit-compiled callable.
+JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+
+#: Canonical names of functools.partial (for ``@partial(jax.jit, ...)``).
+PARTIAL_NAMES = {"functools.partial"}
+
+SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic: rule id, location, message, suppression state."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{tag} {self.message}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"jaxlint:\s*disable(?P<next>-next)?\s*=\s*(?P<rules>[A-Za-z0-9_,\s]+)"
+)
+
+
+def suppressions(source: str) -> dict:
+    """``{line: {rule ids}}`` from ``# jaxlint: disable=R00x[,R00y]`` and
+    ``# jaxlint: disable-next=R00x`` comments. ``all`` suppresses every
+    rule on that line. Free-form justification text after the rule list is
+    encouraged and ignored (the first token that isn't an id ends the
+    list), e.g. ``# jaxlint: disable=R003 benchmark: the sync IS the
+    measurement``.
+    """
+    out: dict = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = set()
+        for part in re.split(r"[\s,]+", m.group("rules").strip()):
+            if re.fullmatch(r"[Rr]\d{3}", part):
+                rules.add(part.upper())
+            elif part.lower() == "all":
+                rules.add("ALL")
+            else:
+                break  # justification text starts here
+        if not rules:
+            continue
+        line = tok.start[0] + (1 if m.group("next") else 0)
+        out.setdefault(line, set()).update(rules)
+    return out
+
+
+def is_suppressed(finding: Finding, supp: dict) -> bool:
+    rules = supp.get(finding.line, ())
+    return finding.rule in rules or "ALL" in rules
+
+
+def collect_py_files(paths: Iterable) -> list:
+    """Expand files/directories into a sorted list of ``.py`` paths."""
+    files = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.is_file():
+            files.append(p)
+        else:
+            raise FileNotFoundError(f"jaxlint: no such file or directory: {p}")
+    # De-dup while keeping order (a dir arg may repeat an explicit file).
+    seen, out = set(), []
+    for f in files:
+        key = str(f)
+        if key not in seen and "__pycache__" not in key:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+
+def annotate_parents(tree: ast.Module) -> None:
+    tree._jl_parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._jl_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_jl_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing(node: ast.AST, types) -> Optional[ast.AST]:
+    for anc in ancestors(node):
+        if isinstance(anc, types):
+            return anc
+    return None
+
+
+def enclosing_scope(node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing function/lambda/module (skips ClassDef: class
+    bodies don't form a name scope visible from methods)."""
+    return enclosing(node, SCOPE_NODES)
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    return enclosing(node, ast.ClassDef)
+
+
+def scope_chain(node: ast.AST) -> Iterator[ast.AST]:
+    """Enclosing name scopes, innermost first, ending at the module."""
+    cur = enclosing_scope(node)
+    while cur is not None:
+        yield cur
+        if isinstance(cur, ast.Module):
+            return
+        cur = enclosing_scope(cur)
+
+
+def statement_of(node: ast.AST) -> ast.stmt:
+    """The statement a node belongs to (the nearest ``ast.stmt`` ancestor,
+    or the node itself when it already is one)."""
+    cur = node
+    while not isinstance(cur, ast.stmt):
+        nxt = parent(cur)
+        if nxt is None:
+            break
+        cur = nxt
+    return cur  # type: ignore[return-value]
+
+
+def dotted_parts(node: ast.AST) -> Optional[list]:
+    """``a.b.c`` attribute chain as ``["a", "b", "c"]``; None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def const_tuple(node: Optional[ast.AST]) -> tuple:
+    """A literal int/str or tuple/list of them as a Python tuple; ``()``
+    when absent or not statically resolvable."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not isinstance(e, ast.Constant):
+                return ()
+            vals.append(e.value)
+        return tuple(vals)
+    return ()
+
+
+def ref_key(node: ast.AST):
+    """A stable key for "the same storage location": local names become
+    ``("local", name)``, ``self.attr`` becomes ``("self", attr)``; anything
+    deeper (``a.b.c``, subscripts) is None — not tracked."""
+    if isinstance(node, ast.Name):
+        return ("local", node.id)
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return ("self", node.attr)
+    return None
+
+
+def flatten_targets(target: ast.AST) -> Iterator[ast.AST]:
+    """Assignment target(s) flattened through tuple/list/star nesting."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from flatten_targets(elt)
+    elif isinstance(target, ast.Starred):
+        yield from flatten_targets(target.value)
+    else:
+        yield target
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """Static metadata of one jit-wrapped callable."""
+
+    node: ast.AST  # the jax.jit call / decorator expression
+    donate_argnums: tuple = ()
+    static_argnums: tuple = ()
+    static_argnames: tuple = ()
+    target: Optional[ast.AST] = None  # the wrapped FunctionDef/Lambda
+    binding: Optional[str] = None  # display name of the binding, if any
+
+
+class ModuleModel:
+    """Semantic model of one parsed module, shared by all rules."""
+
+    def __init__(self, path, source: str, tree: ast.Module):
+        self.path = str(path)
+        self.source = source
+        self.tree = tree
+        annotate_parents(tree)
+        self.aliases: dict = {}
+        self._collect_imports()
+        #: binding key -> JitInfo. Keys: ("name", scope-node, name) for
+        #: plain assignments/defs, ("self", class-node, attr) for
+        #: ``self.attr = jax.jit(...)`` inside any method of the class.
+        self.jit_bindings: dict = {}
+        #: FunctionDef/Lambda node -> JitInfo for every jit target whose
+        #: definition is in this module.
+        self.jitted_defs: dict = {}
+        self._collect_jit()
+
+    # -- imports ---------------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an expression through the module's
+        import aliases: with ``import jax.numpy as jnp``, ``jnp.copy``
+        resolves to ``"jax.numpy.copy"``. None for non-name expressions."""
+        parts = dotted_parts(node)
+        if not parts:
+            return None
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    # -- jit registry ----------------------------------------------------
+
+    def _jit_info_from_call(self, call: ast.Call) -> Optional[JitInfo]:
+        if self.resolve(call.func) not in JIT_WRAPPERS:
+            return None
+        kw = {k.arg: k.value for k in call.keywords if k.arg}
+        info = JitInfo(
+            node=call,
+            donate_argnums=const_tuple(kw.get("donate_argnums")),
+            static_argnums=const_tuple(kw.get("static_argnums")),
+            static_argnames=const_tuple(kw.get("static_argnames")),
+        )
+        if call.args:
+            fn = call.args[0]
+            if isinstance(fn, ast.Lambda):
+                info.target = fn
+            elif isinstance(fn, ast.Name):
+                info.target = self._find_def(fn.id, call)
+        return info
+
+    def _decorator_jit_info(self, dec: ast.AST) -> Optional[JitInfo]:
+        if self.resolve(dec) in JIT_WRAPPERS:
+            return JitInfo(node=dec)
+        if isinstance(dec, ast.Call):
+            fname = self.resolve(dec.func)
+            kw = {k.arg: k.value for k in dec.keywords if k.arg}
+            if fname in JIT_WRAPPERS:
+                pass
+            elif fname in PARTIAL_NAMES or (fname or "").endswith(".partial"):
+                if not dec.args or self.resolve(dec.args[0]) not in JIT_WRAPPERS:
+                    return None
+            else:
+                return None
+            return JitInfo(
+                node=dec,
+                donate_argnums=const_tuple(kw.get("donate_argnums")),
+                static_argnums=const_tuple(kw.get("static_argnums")),
+                static_argnames=const_tuple(kw.get("static_argnames")),
+            )
+        return None
+
+    def _find_def(self, name: str, from_node: ast.AST) -> Optional[ast.AST]:
+        """The FunctionDef named ``name`` visible from ``from_node``'s
+        scope chain (nearest enclosing scope wins)."""
+        for scope in scope_chain(from_node):
+            for stmt in ast.walk(scope):
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name == name
+                    and enclosing_scope(stmt) is scope
+                ):
+                    return stmt
+        return None
+
+    def _collect_jit(self) -> None:
+        for node in ast.walk(self.tree):
+            # name = jax.jit(fn, ...)  /  self.attr = jax.jit(fn, ...)
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                info = self._jit_info_from_call(node.value)
+                if info is None:
+                    continue
+                for target in node.targets:
+                    key = ref_key(target)
+                    if key is None:
+                        continue
+                    if key[0] == "local":
+                        scope = enclosing_scope(node)
+                        info.binding = key[1]
+                        self.jit_bindings[("name", scope, key[1])] = info
+                    else:  # ("self", attr)
+                        cls = enclosing_class(node)
+                        if cls is not None:
+                            info.binding = f"self.{key[1]}"
+                            self.jit_bindings[("self", cls, key[1])] = info
+                if info.target is not None:
+                    self.jitted_defs[info.target] = info
+            # bare jax.jit(lambda/fn) used inline (no binding)
+            elif isinstance(node, ast.Call):
+                info = self._jit_info_from_call(node)
+                if info is not None and info.target is not None:
+                    self.jitted_defs.setdefault(info.target, info)
+            # @jax.jit / @partial(jax.jit, ...) decorated defs
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    info = self._decorator_jit_info(dec)
+                    if info is not None:
+                        info.target = node
+                        info.binding = node.name
+                        self.jitted_defs[node] = info
+                        scope = enclosing_scope(node)
+                        self.jit_bindings[("name", scope, node.name)] = info
+                        break
+
+    def jit_info_for_call(self, call: ast.Call) -> Optional[JitInfo]:
+        """JitInfo for a call site of a known jit-wrapped callable:
+        ``self.step(...)`` (class registry) or ``step(...)`` (scope-chain
+        lookup). None when the callee isn't statically known to be jitted."""
+        f = call.func
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+        ):
+            cls = enclosing_class(call)
+            if cls is not None:
+                return self.jit_bindings.get(("self", cls, f.attr))
+            return None
+        if isinstance(f, ast.Name):
+            for scope in scope_chain(call):
+                info = self.jit_bindings.get(("name", scope, f.id))
+                if info is not None:
+                    return info
+        return None
+
+    def static_positions(self, info: JitInfo):
+        """(static argnum set, static argname set) for a jit callable,
+        mapping ``static_argnames`` onto positions when the target def is
+        known in this module."""
+        nums = {n for n in info.static_argnums if isinstance(n, int)}
+        names = {n for n in info.static_argnames if isinstance(n, str)}
+        target = info.target
+        if target is not None and not isinstance(target, ast.Lambda):
+            params = [a.arg for a in target.args.args]
+            for name in list(names):
+                if name in params:
+                    nums.add(params.index(name))
+            for n in list(nums):
+                if 0 <= n < len(params):
+                    names.add(params[n])
+        return nums, names

@@ -1,0 +1,14 @@
+"""jaxlint rule modules — importing this package registers every rule.
+
+One module per rule, one class per module; see docs/LINT.md for the rule
+catalogue and waternet_tpu/analysis/registry.py for the registration
+contract.
+"""
+
+from waternet_tpu.analysis.rules import (  # noqa: F401
+    donation,
+    hostsync,
+    recompile,
+    rng,
+    tracerleak,
+)

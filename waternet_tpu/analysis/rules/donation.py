@@ -1,0 +1,240 @@
+"""R001 — donation-after-use.
+
+``donate_argnums`` lets XLA write a step's outputs into its inputs'
+buffers. Two ways that goes wrong, both of which this repo has met:
+
+* the caller keeps using the donated reference after the call — the
+  classic read-after-free, which jax only reports lazily (and only when
+  the runtime notices);
+* the donated argument merely *borrows* host memory: on CPU,
+  ``jax.device_put`` zero-copies aligned numpy arrays, so donating such a
+  buffer frees pages the host still owns — the PR-1
+  ``TrainingEngine._own_device_state`` corruption class, observed as
+  nondeterministic garbage in param leaves after a checkpoint restore.
+
+The rule therefore checks every statically-resolvable call site of a
+jit-with-donation callable (see ``ModuleModel.jit_bindings``):
+
+1. a donated argument that is a plain name or ``self.attr`` must be
+   rebound by the same statement (``state, m = step(state, ...)``) or
+   never read again afterwards in the same function;
+2. a donated argument must not be the direct result of
+   ``jax.device_put(...)``;
+3. within a class, a donated ``self.attr`` must not be assigned from a
+   method that returns a bare ``jax.device_put`` result (no ``jnp.copy``
+   ownership copy) — the cross-method form of (2).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from waternet_tpu.analysis.core import (
+    Finding,
+    ModuleModel,
+    enclosing_class,
+    enclosing_scope,
+    flatten_targets,
+    ref_key,
+    statement_of,
+)
+from waternet_tpu.analysis.registry import Rule, register
+
+_COPY_NAMES = {
+    "jax.numpy.copy",
+    "jax.numpy.array",
+    "numpy.array",
+}
+_TREE_MAP_NAMES = {"jax.tree.map", "jax.tree_util.tree_map", "jax.tree_map"}
+
+
+def _returns_borrowed(model: ModuleModel, fn: ast.FunctionDef) -> bool:
+    """True when some ``return`` of ``fn`` resolves (through simple local
+    assignments) to a bare ``jax.device_put(...)`` call — i.e. the method
+    hands out buffers that may alias host numpy memory."""
+    env: dict = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and enclosing_scope(node) is fn:
+                env.setdefault(t.id, []).append(node)
+    for ret in ast.walk(fn):
+        if not isinstance(ret, ast.Return) or ret.value is None:
+            continue
+        if enclosing_scope(ret) is not fn:
+            continue
+        expr: Optional[ast.AST] = ret.value
+        for _ in range(8):  # follow a short local assignment chain
+            if isinstance(expr, ast.Name):
+                assigns = [
+                    a for a in env.get(expr.id, []) if a.lineno <= ret.lineno
+                ]
+                if not assigns:
+                    break
+                expr = assigns[-1].value
+                continue
+            break
+        if isinstance(expr, ast.Call):
+            name = model.resolve(expr.func)
+            if name == "jax.device_put":
+                return True
+            if name in _COPY_NAMES:
+                continue
+            if name in _TREE_MAP_NAMES:
+                continue
+    return False
+
+
+def _borrowed_attrs(model: ModuleModel, cls: ast.ClassDef) -> dict:
+    """``{attr: description}`` for self attributes assigned from borrowed
+    sources anywhere in the class."""
+    borrowed_methods = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.FunctionDef) and _returns_borrowed(model, stmt):
+            borrowed_methods[stmt.name] = stmt
+    out: dict = {}
+    for node in ast.walk(cls):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        key = ref_key(node.targets[0])
+        if key is None or key[0] != "self":
+            continue
+        value = node.value
+        if isinstance(value, ast.Call):
+            name = model.resolve(value.func)
+            if name == "jax.device_put":
+                out[key[1]] = "assigned directly from jax.device_put"
+                continue
+            f = value.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                and f.attr in borrowed_methods
+            ):
+                out[key[1]] = (
+                    f"assigned from self.{f.attr}(), which returns a bare "
+                    "jax.device_put result (no jnp.copy ownership copy)"
+                )
+    return out
+
+
+def _display(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on valid ASTs
+        return "<expr>"
+
+
+@register
+class DonationAfterUse(Rule):
+    id = "R001"
+    name = "donation-after-use"
+    description = (
+        "an argument donated via donate_argnums is read after the jitted "
+        "call, or aliases a host NumPy buffer (zero-copy device_put)"
+    )
+
+    def check(self, model: ModuleModel) -> Iterator[Finding]:
+        if not any(i.donate_argnums for i in model.jit_bindings.values()):
+            return
+        borrowed_cache: dict = {}
+        for call in ast.walk(model.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            info = model.jit_info_for_call(call)
+            if info is None or not info.donate_argnums:
+                continue
+            if any(isinstance(a, ast.Starred) for a in call.args):
+                continue  # positions not statically known
+            callee = info.binding or _display(call.func)
+            for argnum in info.donate_argnums:
+                if not isinstance(argnum, int) or argnum >= len(call.args):
+                    continue
+                arg = call.args[argnum]
+                if (
+                    isinstance(arg, ast.Call)
+                    and model.resolve(arg.func) == "jax.device_put"
+                ):
+                    yield self.finding(
+                        model,
+                        arg,
+                        f"argument {argnum} of `{callee}` is donated but is "
+                        "a bare jax.device_put result — on CPU device_put "
+                        "zero-copies host numpy buffers, so donation frees "
+                        "memory the host still owns; materialize with "
+                        "jnp.copy first",
+                    )
+                    continue
+                key = ref_key(arg)
+                if key is None:
+                    continue
+                if key[0] == "self":
+                    cls = enclosing_class(call)
+                    if cls is not None:
+                        if cls not in borrowed_cache:
+                            borrowed_cache[cls] = _borrowed_attrs(model, cls)
+                        why = borrowed_cache[cls].get(key[1])
+                        if why:
+                            yield self.finding(
+                                model,
+                                arg,
+                                f"`self.{key[1]}` is donated (argument "
+                                f"{argnum} of `{callee}`) but may alias a "
+                                f"host numpy buffer: {why}. Donating a "
+                                "borrowed buffer frees pages the host "
+                                "still owns (the PR-1 _own_device_state "
+                                "corruption class)",
+                            )
+                yield from self._read_after(model, call, arg, key, callee, argnum)
+
+    def _read_after(self, model, call, arg, key, callee, argnum):
+        stmt = statement_of(call)
+        # Rebound by the same statement (the canonical
+        # ``state, m = step(state, ...)`` idiom)?
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for leaf in flatten_targets(t):
+                    if ref_key(leaf) == key:
+                        return
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if ref_key(stmt.target) == key:
+                return
+        fn = enclosing_scope(call)
+        if fn is None or isinstance(fn, ast.Module):
+            return
+        stmt_end = getattr(stmt, "end_lineno", stmt.lineno)
+        rebind_line = None
+        for node in ast.walk(fn):
+            k = None
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                k = ref_key(node)
+            if k != key or node.lineno <= stmt_end:
+                continue
+            if isinstance(getattr(node, "ctx", None), ast.Store):
+                if rebind_line is None or node.lineno < rebind_line:
+                    rebind_line = node.lineno
+        for node in ast.walk(fn):
+            k = None
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                k = ref_key(node)
+            if k != key or node.lineno <= stmt_end:
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            # Attribute loads that are just the base of a store
+            # (``x.y = ...`` loads ``x``) still count as uses of x only,
+            # and ref_key already separates the two.
+            if rebind_line is not None and node.lineno >= rebind_line:
+                continue
+            name = key[1] if key[0] == "local" else f"self.{key[1]}"
+            yield self.finding(
+                model,
+                node,
+                f"`{name}` is read here after being donated to `{callee}` "
+                f"(argument {argnum} at line {call.lineno}) — donated "
+                "buffers are invalidated by the call; rebind the result "
+                "to the same name or copy before donating",
+            )
+            return  # one finding per donation site is enough

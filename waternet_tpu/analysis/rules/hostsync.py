@@ -1,0 +1,155 @@
+"""R003 — host-sync-in-hot-loop.
+
+The engine's throughput rests on the deferred-metrics-fetch discipline:
+a loop that dispatches jitted device work must not also force a
+device->host sync (``.item()``, ``float()``, ``np.asarray``,
+``block_until_ready``, ``jax.device_get``) — each sync drains the device
+queue and serializes host and device, exactly the reference trainer's 8+
+syncs/step pathology the engine was built to remove. The sanctioned
+pattern (collect device metric dicts, fetch once after the loop) is what
+``TrainingEngine._drive_train_epoch`` does; until this rule it was
+convention only.
+
+The rule fires on a sync call inside a ``for``/``while`` body that also
+calls a statically-known jit-compiled callable (the module/class jit
+registry — ``self.train_step``, a ``@jax.jit`` nested def, ...). Loops
+that only *fetch* (the epoch-end ``for m in pending: float(...)`` loop)
+dispatch nothing and stay clean by construction, which is precisely the
+discipline the rule encodes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from waternet_tpu.analysis.core import (
+    Finding,
+    LOOP_NODES,
+    ModuleModel,
+    SCOPE_NODES,
+    enclosing_scope,
+    flatten_targets,
+)
+from waternet_tpu.analysis.registry import Rule, register
+
+_SYNC_CALLS = {
+    "jax.device_get": "jax.device_get() forces a device->host transfer",
+    "jax.block_until_ready": "jax.block_until_ready() drains the device queue",
+    "numpy.asarray": "np.asarray() on a device value copies it to host synchronously",
+    "numpy.array": "np.array() on a device value copies it to host synchronously",
+}
+_SYNC_METHODS = {
+    "item": ".item() blocks on the device value",
+    "tolist": ".tolist() blocks on the device value",
+    "block_until_ready": ".block_until_ready() drains the device queue",
+}
+_SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+def _iter_loop_body(loop) -> Iterator[ast.AST]:
+    """All nodes in a loop's body/orelse, not descending into nested
+    function definitions (defining a closure executes nothing)."""
+    stack = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, SCOPE_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _root_name(node: ast.AST):
+    """The base Name of a Name/Attribute/Subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _device_names(model: ModuleModel, scope) -> set:
+    """Names in ``scope`` bound (possibly via tuple unpack) from a call to
+    a statically-known jitted callable — i.e. names that definitely hold
+    device values. Gates the builtin-cast check: ``float(i)`` on a loop
+    counter is a plain host cast, ``float(m["loss"])`` on a step result
+    is a sync."""
+    names: set = set()
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Assign):
+            continue
+        calls = [node.value] if isinstance(node.value, ast.Call) else [
+            e for e in getattr(node.value, "elts", []) if isinstance(e, ast.Call)
+        ]
+        if not any(model.jit_info_for_call(c) is not None for c in calls):
+            continue
+        for t in node.targets:
+            for leaf in flatten_targets(t):
+                if isinstance(leaf, ast.Name):
+                    names.add(leaf.id)
+    return names
+
+
+def _sync_reason(model: ModuleModel, node: ast.AST, device_names: set):
+    if not isinstance(node, ast.Call):
+        return None
+    fname = model.resolve(node.func)
+    if fname in _SYNC_CALLS:
+        return _SYNC_CALLS[fname]
+    if fname in _SYNC_BUILTINS and "." not in fname:
+        if (
+            len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)
+            and _root_name(node.args[0]) in device_names
+        ):
+            return (
+                f"{fname}() on a device value blocks until the value is "
+                "computed and transferred"
+            )
+        return None
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHODS:
+        if not node.args and not node.keywords:
+            return _SYNC_METHODS[node.func.attr]
+    return None
+
+
+@register
+class HostSyncInHotLoop(Rule):
+    id = "R003"
+    name = "host-sync-in-hot-loop"
+    description = (
+        "a loop that dispatches jitted device work also forces a "
+        "device->host sync, serializing host and device per iteration"
+    )
+
+    def check(self, model: ModuleModel) -> Iterator[Finding]:
+        if not model.jit_bindings:
+            return
+        reported: set = set()
+        device_cache: dict = {}
+        for loop in ast.walk(model.tree):
+            if not isinstance(loop, LOOP_NODES):
+                continue
+            dispatch = None
+            for node in _iter_loop_body(loop):
+                if isinstance(node, ast.Call):
+                    info = model.jit_info_for_call(node)
+                    if info is not None:
+                        dispatch = info.binding or "a jitted callable"
+                        break
+            if dispatch is None:
+                continue
+            scope = enclosing_scope(loop) or model.tree
+            if scope not in device_cache:
+                device_cache[scope] = _device_names(model, scope)
+            for node in _iter_loop_body(loop):
+                reason = _sync_reason(model, node, device_cache[scope])
+                if reason is None or id(node) in reported:
+                    continue
+                reported.add(id(node))
+                yield self.finding(
+                    model,
+                    node,
+                    f"host sync inside the hot loop at line {loop.lineno} "
+                    f"(which dispatches `{dispatch}`): {reason}. Defer the "
+                    "fetch past the loop (collect device values, read them "
+                    "once per epoch) to keep the device queue full",
+                )

@@ -1,0 +1,177 @@
+"""R004 — recompile-hazard.
+
+XLA compilation is the single most expensive host-side event in this
+codebase (the tier-1 suite ships a persistent compile cache just to
+contain it), and a step function that silently recompiles mid-epoch
+erases every throughput number the benches report. Three statically
+detectable ways to cause that:
+
+* **unhashable static argument** — a call site passes a list/dict/set
+  literal at a ``static_argnums``/``static_argnames`` position; jax
+  raises at best, and at worst (pre-0.4 semantics, wrapper layers) the
+  cache misses on every call;
+* **jit under a loop** — ``jax.jit(fn)`` evaluated inside a ``for``/
+  ``while`` body builds a *fresh* callable (fresh cache) each iteration,
+  recompiling every time;
+* **Python branch on a traced value** — ``if x > 0:`` inside a jitted
+  function where ``x`` is a traced (non-static) parameter raises a
+  ``TracerBoolConversionError`` at trace time, or — when the branch sits
+  behind a shape-dependent guard — forces one compile per taken path.
+  ``is None`` checks and attribute accesses (``x.shape``, ``x.ndim``,
+  ``x.dtype``) are static under tracing and stay exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from waternet_tpu.analysis.core import (
+    Finding,
+    JIT_WRAPPERS,
+    LOOP_NODES,
+    ModuleModel,
+    SCOPE_NODES,
+    parent,
+)
+from waternet_tpu.analysis.registry import Rule, register
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+#: Builtins whose result on a traced array is static (safe to branch on).
+_STATIC_FUNCS = {"len", "isinstance", "hasattr", "getattr", "callable"}
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+def _traced_name_in(test: ast.AST, traced: set):
+    """A bare traced-parameter Name (or subscript of one) inside a branch
+    test, skipping static contexts: attribute roots (``x.shape``),
+    ``len(x)``-style static builtins, and ``is None`` comparisons."""
+    if _is_none_check(test):
+        return None
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in traced):
+            continue
+        p = parent(node)
+        if isinstance(p, ast.Attribute) and p.value is node:
+            continue  # x.shape / x.ndim / x.dtype are static
+        if (
+            isinstance(p, ast.Call)
+            and isinstance(p.func, ast.Name)
+            and p.func.id in _STATIC_FUNCS
+        ):
+            continue
+        if isinstance(p, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in p.ops
+        ):
+            continue
+        return node
+    return None
+
+
+@register
+class RecompileHazard(Rule):
+    id = "R004"
+    name = "recompile-hazard"
+    description = (
+        "jitted callables whose static args are unhashable, jit applied "
+        "inside a loop, or Python control flow branching on traced values"
+    )
+
+    def check(self, model: ModuleModel) -> Iterator[Finding]:
+        yield from self._unhashable_static(model)
+        yield from self._jit_in_loop(model)
+        yield from self._traced_branch(model)
+
+    def _unhashable_static(self, model) -> Iterator[Finding]:
+        for call in ast.walk(model.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            info = model.jit_info_for_call(call)
+            if info is None:
+                continue
+            nums, names = model.static_positions(info)
+            if not nums and not names:
+                continue
+            callee = info.binding or "jitted callable"
+            for pos in nums:
+                if pos < len(call.args) and isinstance(call.args[pos], _UNHASHABLE):
+                    yield self.finding(
+                        model,
+                        call.args[pos],
+                        f"static argument {pos} of `{callee}` is an "
+                        "unhashable literal — static args are cache keys "
+                        "and must be hashable; pass a tuple (or mark the "
+                        "arg non-static)",
+                    )
+            for kwarg in call.keywords:
+                if kwarg.arg in names and isinstance(kwarg.value, _UNHASHABLE):
+                    yield self.finding(
+                        model,
+                        kwarg.value,
+                        f"static argument `{kwarg.arg}` of `{callee}` is an "
+                        "unhashable literal — static args are cache keys "
+                        "and must be hashable; pass a tuple (or mark the "
+                        "arg non-static)",
+                    )
+
+    def _jit_in_loop(self, model) -> Iterator[Finding]:
+        for call in ast.walk(model.tree):
+            if not (
+                isinstance(call, ast.Call)
+                and model.resolve(call.func) in JIT_WRAPPERS
+            ):
+                continue
+            node = call
+            while True:
+                anc = parent(node)
+                if anc is None or isinstance(anc, SCOPE_NODES):
+                    break
+                if isinstance(anc, LOOP_NODES) and node not in (
+                    getattr(anc, "iter", None),
+                    getattr(anc, "test", None),
+                ):
+                    yield self.finding(
+                        model,
+                        call,
+                        "jax.jit applied inside a loop builds a fresh "
+                        "callable (and compile cache) every iteration — "
+                        "hoist the jit out of the loop",
+                    )
+                    break
+                node = anc
+
+    def _traced_branch(self, model) -> Iterator[Finding]:
+        for fn, info in model.jitted_defs.items():
+            if isinstance(fn, ast.Lambda):
+                continue  # lambdas can't contain statements
+            params = [a.arg for a in fn.args.args]
+            nums, names = model.static_positions(info)
+            traced = {
+                p
+                for i, p in enumerate(params)
+                if i not in nums and p not in names and p != "self"
+            }
+            if not traced:
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                    test = node.test
+                    hit = _traced_name_in(test, traced)
+                    if hit is None:
+                        continue
+                    kind = type(node).__name__.lower()
+                    yield self.finding(
+                        model,
+                        test,
+                        f"`{kind}` branches on traced parameter "
+                        f"`{hit.id}` inside jitted "
+                        f"`{info.binding or fn.name}` — Python control "
+                        "flow on traced values fails at trace time or "
+                        "recompiles per branch; use jnp.where / "
+                        "lax.cond, or mark the argument static",
+                    )

@@ -1,0 +1,243 @@
+"""R002 — rng-key-reuse.
+
+JAX PRNG keys are values, not stateful generators: passing the same key
+to two sampling sites yields *identical* (or correlated) draws, silently.
+The repo's determinism guarantees (byte-identical resume and pipelined
+epochs in tests/test_pipeline.py / test_resilience.py) depend on every
+key being consumed exactly once between ``split`` / ``fold_in`` points.
+
+The rule tracks, per function scope, variables that definitely hold keys:
+
+* assigned from ``jax.random.PRNGKey`` / ``key`` / ``split`` /
+  ``fold_in`` (tuple-unpacking from ``split`` included);
+* parameters that the body passes as the first argument of some
+  ``jax.random.*`` call (so a numpy ``Generator`` named ``rng`` is never
+  mistaken for a key).
+
+A *consumption* is the key appearing as a call argument — any
+``jax.random`` sampler (``split`` included: splitting and then reusing
+the original key is the classic bug) or any unknown function (passing
+one key to two helpers is reuse too). ``fold_in`` is non-consuming by
+design: deriving many streams from one base via ``fold_in(base, i)`` is
+the intended idiom (the trainer's per-(epoch, batch) keys). Two
+consumptions fire only when both can execute in one pass — sibling
+``if``/``else`` arms don't conflict — and a consumption inside a loop
+whose key was bound outside the loop (and never re-split inside) fires
+on its own.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from waternet_tpu.analysis.core import (
+    Finding,
+    ModuleModel,
+    SCOPE_NODES,
+    enclosing_scope,
+)
+from waternet_tpu.analysis.registry import Rule, register
+
+_KEY_SOURCES = {
+    "jax.random.PRNGKey",
+    "jax.random.key",
+    "jax.random.split",
+    "jax.random.fold_in",
+}
+_NON_CONSUMING = {
+    "jax.random.fold_in",
+    "jax.random.key_data",
+    "jax.random.wrap_key_data",
+    "print",
+    "repr",
+    "str",
+    "len",
+    "id",
+    "type",
+    "isinstance",
+    "copy.copy",
+    "copy.deepcopy",
+    "jax.debug.print",
+    "jax.device_get",
+    "jax.block_until_ready",
+}
+
+
+def _is_key_source(model: ModuleModel, value: ast.AST) -> bool:
+    return (
+        isinstance(value, ast.Call)
+        and model.resolve(value.func) in _KEY_SOURCES
+    )
+
+
+class _Event:
+    __slots__ = ("kind", "name", "node", "branch", "loops")
+
+    def __init__(self, kind, name, node, branch, loops):
+        self.kind = kind  # "bind" | "consume"
+        self.name = name
+        self.node = node
+        self.branch = branch  # tuple of (if-node-id, arm)
+        self.loops = loops  # tuple of loop-node ids, outermost first
+
+
+def _branches_compatible(a, b) -> bool:
+    """False when the two branch paths take different arms of the same
+    ``if`` — then the two sites cannot both execute in one pass."""
+    arms = dict(a)
+    return all(arms.get(nid, arm) == arm for nid, arm in b)
+
+
+def _collect_events(model, fn, keys) -> list:
+    """Lexically-ordered bind/consume events for the tracked key names,
+    not descending into nested function scopes."""
+    events: list = []
+
+    def arg_names(call: ast.Call):
+        for a in list(call.args) + [k.value for k in call.keywords]:
+            inner = a.value if isinstance(a, ast.Starred) else a
+            if isinstance(inner, ast.Name) and inner.id in keys:
+                yield inner
+
+    def visit(node, branch, loops):
+        if isinstance(node, SCOPE_NODES) and node is not fn:
+            return  # nested scope: its own analysis
+        if isinstance(node, ast.Call):
+            fname = model.resolve(node.func)
+            consuming = fname not in _NON_CONSUMING
+            for name_node in arg_names(node):
+                if consuming:
+                    events.append(
+                        _Event("consume", name_node.id, node, branch, loops)
+                    )
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if node.id in keys:
+                events.append(_Event("bind", node.id, node, branch, loops))
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            # Value before targets: in `key, sub = split(key)` the OLD
+            # key is consumed before the NEW binding exists — visiting in
+            # AST field order (targets first) would leave the stale
+            # consume attached to the fresh binding and falsely flag the
+            # carried-key idiom as reuse.
+            if node.value is not None:
+                visit(node.value, branch, loops)
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                visit(t, branch, loops)
+            return
+        if isinstance(node, ast.If):
+            visit(node.test, branch, loops)
+            for stmt in node.body:
+                visit(stmt, branch + ((id(node), "then"),), loops)
+            for stmt in node.orelse:
+                visit(stmt, branch + ((id(node), "else"),), loops)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            new_loops = loops + (id(node),)
+            for child in ast.iter_child_nodes(node):
+                if child in node.body or child in node.orelse:
+                    visit(child, branch, new_loops)
+                else:
+                    visit(child, branch, loops)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, branch, loops)
+
+    body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+    for stmt in body:
+        visit(stmt, (), ())
+    return events
+
+
+@register
+class RngKeyReuse(Rule):
+    id = "R002"
+    name = "rng-key-reuse"
+    description = (
+        "a PRNG key is consumed by two sites without an intervening "
+        "split/fold_in, or consumed inside a loop without per-iteration "
+        "derivation"
+    )
+
+    def check(self, model: ModuleModel) -> Iterator[Finding]:
+        for fn in ast.walk(model.tree):
+            if not isinstance(fn, SCOPE_NODES) or isinstance(fn, ast.Module):
+                continue
+            keys = self._key_names(model, fn)
+            if not keys:
+                continue
+            yield from self._analyze(model, fn, keys)
+
+    def _key_names(self, model, fn) -> set:
+        keys = set()
+        for node in ast.walk(fn):
+            if enclosing_scope(node) is not fn:
+                continue
+            if isinstance(node, ast.Assign) and _is_key_source(model, node.value):
+                for t in node.targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            keys.add(e.id)
+        if not isinstance(fn, ast.Lambda):
+            params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        else:
+            params = {a.arg for a in fn.args.args}
+        # A parameter counts as a key only when the body demonstrably
+        # treats it as one (first argument of a jax.random.* call).
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and (model.resolve(node.func) or "").startswith("jax.random.")
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in params
+            ):
+                keys.add(node.args[0].id)
+        return keys
+
+    def _analyze(self, model, fn, keys) -> Iterator[Finding]:
+        events = _collect_events(model, fn, keys)
+        last_bind: dict = {}
+        last_consume: dict = {}
+        binds_in_loop: dict = {}
+        for ev in events:
+            if ev.kind == "bind":
+                for lid in ev.loops:
+                    binds_in_loop.setdefault(ev.name, set()).add(lid)
+        # Parameters bind at function entry (outside every loop).
+        for ev in events:
+            if ev.kind == "bind":
+                last_bind[ev.name] = ev
+                last_consume.pop(ev.name, None)
+                continue
+            prev = last_consume.get(ev.name)
+            if prev is not None and _branches_compatible(prev.branch, ev.branch):
+                yield self.finding(
+                    model,
+                    ev.node,
+                    f"PRNG key `{ev.name}` is consumed again here (already "
+                    f"consumed at line {prev.node.lineno}) without an "
+                    "intervening split/fold_in — both sites draw from the "
+                    "same stream",
+                )
+                continue  # don't cascade one reuse into N findings
+            bound = last_bind.get(ev.name)
+            bound_loops = set(bound.loops) if bound is not None else set()
+            rebinds = binds_in_loop.get(ev.name, set())
+            for lid in ev.loops:
+                if lid not in bound_loops and lid not in rebinds:
+                    yield self.finding(
+                        model,
+                        ev.node,
+                        f"PRNG key `{ev.name}` is consumed inside a loop "
+                        "but bound outside it and never re-derived per "
+                        "iteration — every iteration draws identical "
+                        "values; derive with jax.random.fold_in(key, i) "
+                        "or split per step",
+                    )
+                    break
+            last_consume[ev.name] = ev

@@ -1,0 +1,158 @@
+"""R005 — tracer-leak.
+
+Code inside a jit-compiled function runs once, at trace time, with
+abstract tracers in place of arrays. Writing a value to anything that
+outlives the trace — ``self``, a global, a closure-captured container —
+leaks a tracer: at best ``jax`` raises ``UnexpectedTracerError`` at the
+later use; at worst the stored object silently holds a stale trace-time
+value while every cached call skips the store entirely (the side effect
+replays only on recompile). Both failure modes are nondeterministic from
+the caller's point of view, which is what makes them worth a static rule.
+
+The rule scans every function this module statically knows to be jitted
+(decorated, or wrapped via ``jax.jit(fn)`` / ``self.step = jax.jit(fn)``)
+and flags:
+
+* assignments to any attribute (``self.x = ...``, ``obj.attr = ...``);
+* assignments through ``global`` / ``nonlocal`` declarations;
+* mutation of names not bound locally: subscript stores
+  (``cache[k] = v``) and mutating method calls (``.append``, ``.add``,
+  ``.update``, ...) on closure or module-level objects.
+
+Locally-created containers are fine — building a dict of metrics inside
+the step and returning it is the engine's own idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from waternet_tpu.analysis.core import (
+    Finding,
+    ModuleModel,
+    SCOPE_NODES,
+    flatten_targets,
+)
+from waternet_tpu.analysis.registry import Rule, register
+
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "setdefault",
+    "pop",
+    "remove",
+    "clear",
+    "__setitem__",
+}
+
+
+def _local_names(fn) -> set:
+    """Names bound in ``fn``'s own scope (params, assignments, loop and
+    with targets, comprehension targets) — stores to these are trace-local
+    and safe."""
+    names = set()
+    if not isinstance(fn, ast.Lambda):
+        args = fn.args
+        for a in (
+            args.args + args.posonlyargs + args.kwonlyargs
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            names.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(node.name)
+    return names
+
+
+@register
+class TracerLeak(Rule):
+    id = "R005"
+    name = "tracer-leak"
+    description = (
+        "a traced value is stored into self/globals/closures that "
+        "outlive the trace"
+    )
+
+    def check(self, model: ModuleModel) -> Iterator[Finding]:
+        for fn, info in model.jitted_defs.items():
+            if isinstance(fn, ast.Lambda):
+                continue
+            name = info.binding or fn.name
+            locals_ = _local_names(fn)
+            declared = set()  # global/nonlocal names in any nested block
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    declared.update(node.names)
+            for node in ast.walk(fn):
+                yield from self._check_node(model, fn, name, locals_, declared, node)
+
+    def _check_node(self, model, fn, name, locals_, declared, node):
+        # Assignments: attribute targets always leak; Name targets leak
+        # when routed through global/nonlocal; subscript stores leak when
+        # the base container isn't a local.
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                yield from self._check_target(model, name, locals_, declared, t)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATORS
+                and isinstance(f.value, ast.Name)
+                and (f.value.id not in locals_ or f.value.id in declared)
+            ):
+                yield self.finding(
+                    model,
+                    node,
+                    f"`.{f.attr}()` mutates `{f.value.id}`, which is not "
+                    f"local to jitted `{name}` — the mutation happens at "
+                    "trace time only (skipped on cached calls) and can "
+                    "leak a tracer into an object that outlives the "
+                    "trace; return the value instead",
+                )
+
+    def _check_target(self, model, name, locals_, declared, target):
+        for leaf in flatten_targets(target):
+            if isinstance(leaf, ast.Attribute):
+                yield self.finding(
+                    model,
+                    leaf,
+                    f"assignment to attribute `{ast.unparse(leaf)}` inside "
+                    f"jitted `{name}` stores a trace-time value on an "
+                    "object that outlives the trace (runs only when "
+                    "tracing, leaks a tracer) — return the value and "
+                    "store it outside the jitted function",
+                )
+            elif isinstance(leaf, ast.Subscript):
+                base = leaf.value
+                if isinstance(base, ast.Name) and (
+                    base.id not in locals_ or base.id in declared
+                ):
+                    yield self.finding(
+                        model,
+                        leaf,
+                        f"subscript store into non-local `{base.id}` inside "
+                        f"jitted `{name}` mutates state that outlives the "
+                        "trace — the write happens at trace time only and "
+                        "can leak a tracer",
+                    )
+            elif isinstance(leaf, ast.Name) and leaf.id in declared:
+                yield self.finding(
+                    model,
+                    leaf,
+                    f"assignment to global/nonlocal `{leaf.id}` inside "
+                    f"jitted `{name}` stores a trace-time value beyond the "
+                    "trace (and is skipped entirely on cached calls)",
+                )

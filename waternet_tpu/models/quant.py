@@ -127,12 +127,14 @@ def calibration_stats(params, batches: Sequence[Tuple]) -> Dict[str, float]:
         _forward(layers, x, wb, ce, gc, _conv_f32, observe=observe)
         return stats
 
+    # Dispatch every calibration batch before fetching anything: the
+    # per-batch device_get serialized host and device per step (R003).
+    pending = [
+        one(jnp.asarray(x), jnp.asarray(wb), jnp.asarray(ce), jnp.asarray(gc))
+        for x, wb, ce, gc in batches
+    ]
     agg: Dict[str, float] = {}
-    for x, wb, ce, gc in batches:
-        stats = jax.device_get(
-            one(jnp.asarray(x), jnp.asarray(wb), jnp.asarray(ce),
-                jnp.asarray(gc))
-        )
+    for stats in jax.device_get(pending):
         for k, v in stats.items():
             agg[k] = max(agg.get(k, 0.0), float(v))
     return agg

@@ -43,8 +43,13 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exports shard_map at top level ...
+    from jax import shard_map
+except ImportError:  # ... older builds only under experimental
+    from jax.experimental.shard_map import shard_map
 
 from waternet_tpu.parallel.mesh import SPATIAL_AXIS
 
