@@ -33,6 +33,13 @@ measured over a real host-fed epoch. A ``_hostfed_sync`` A/B line
 synchronously so the overlap win is visible in one run; disable both with
 WATERNET_BENCH_WORKERS=0.
 
+``--config serve`` measures the inference serving path instead: the
+``mixed_res_dir_images_per_sec`` line A/Bs the shape-bucketed dynamic
+batcher (waternet_tpu/serving/, docs/SERVING.md) against the legacy
+``--exact-shapes`` per-shape batching on a shuffled every-image-unique
+resolution stream, reporting batch occupancy, padding overhead, and the
+compile count of each mode.
+
 The last stdout line is the contract JSON:
 {"metric", "value", "unit", "vs_baseline"}. When no hardware is reachable
 the process exits rc 0 with ``value: 0.0`` and an ``error`` field — "no
@@ -207,6 +214,115 @@ def bench_video_device_resident(hw=(1080, 1920), batch=4, steps=12, quantize=Non
         "frame_ms": round(dt / (batch * steps) * 1e3, 3),
         "compile_sec": round(compile_s, 1),
         "quantized": bool(quantize),
+    }
+
+
+def bench_serving(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+):
+    """Mixed-resolution directory-serving throughput: the shape-bucketed
+    dynamic batcher (waternet_tpu/serving/, docs/SERVING.md) A/B'd against
+    the legacy ``--exact-shapes`` per-shape batching on an identical
+    shuffled image population where every image has a unique resolution —
+    the worst case for per-shape compilation, and the realistic case for
+    user-upload traffic. Returns the ``mixed_res_dir_images_per_sec``
+    contract-line dict (value = bucketed throughput, end-to-end including
+    host preprocessing and D2H readback; AOT warmup is reported separately
+    as ``warmup_sec`` because a server pays it once, not per stream).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.models import WaterNet
+    from waternet_tpu.serving import (
+        DynamicBatcher,
+        ExactShapeBatcher,
+        derive_buckets,
+    )
+
+    n_images = (
+        _env_int("WATERNET_BENCH_SERVE_IMAGES", 48)
+        if n_images is None else n_images
+    )
+    max_batch = (
+        _env_int("WATERNET_BENCH_SERVE_BATCH", 8)
+        if max_batch is None else max_batch
+    )
+    max_buckets = (
+        _env_int("WATERNET_BENCH_SERVE_BUCKETS", 3)
+        if max_buckets is None else max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    params = WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+    # Three resolution classes with per-image jitter, deduplicated so
+    # every image really is its own unique shape (uploads are never
+    # aligned; the per-shape baseline must get zero free jit-cache hits),
+    # shuffled so shapes interleave — consecutive-same-shape grouping
+    # gets no free rides either.
+    rng = np.random.default_rng(0)
+    shapes = []
+    seen = set()
+    for i in range(n_images):
+        scale = (1.0, 1.5, 2.0)[i % 3]
+        h = int(base * scale) + int(rng.integers(0, 8))
+        w = int(base * scale * 4 // 3) + int(rng.integers(0, 8))
+        while (h, w) in seen:
+            w += 1
+        seen.add((h, w))
+        shapes.append((h, w))
+    rng.shuffle(shapes)
+    images = [
+        rng.integers(0, 256, (h, w, 3), dtype=np.uint8) for h, w in shapes
+    ]
+
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+
+    engine = InferenceEngine(params=params)
+    t0 = time.perf_counter()
+    batcher = DynamicBatcher(engine, ladder, max_batch=max_batch)
+    warmup_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = batcher.map_ordered(images)
+    bucketed_s = time.perf_counter() - t0
+    batcher.close()
+    assert len(outs) == n_images
+    summary = batcher.stats.summary()
+
+    # Fresh engine for the A/B: the legacy path must pay its own per-shape
+    # jit compiles, exactly as a pre-serving CLI run would.
+    engine_exact = InferenceEngine(params=params)
+    exact = ExactShapeBatcher(engine_exact, max_batch)
+    t0 = time.perf_counter()
+    done = 0
+    for i, im in enumerate(images):
+        done += len(exact.push(i, im))
+    done += len(exact.flush())
+    exact_s = time.perf_counter() - t0
+    assert done == n_images
+
+    bucketed_ips = n_images / bucketed_s
+    exact_ips = n_images / exact_s
+    return {
+        "metric": "mixed_res_dir_images_per_sec",
+        "value": round(bucketed_ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "exact_shapes_images_per_sec": round(exact_ips, 2),
+        "speedup_vs_exact": round(bucketed_ips / exact_ips, 2),
+        "buckets": ladder.describe(),
+        "batch_occupancy": summary["batch_occupancy"],
+        "padding_overhead": summary["padding_overhead"],
+        "compiles_bucketed": summary["compiles"],
+        "compiles_exact": exact.stats.compiles,
+        "latency_ms": summary["latency_ms"],
+        "warmup_sec": round(warmup_s, 1),
+        "n_images": n_images,
+        "unique_shapes": len(set(shapes)),
+        "max_batch": max_batch,
     }
 
 
@@ -724,9 +840,11 @@ def main():
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
-        "--config", choices=["train", "video"], default="train",
-        help="train (default; the one-line contract metric) or video "
-        "(full-res frame throughput, BASELINE config 5)",
+        "--config", choices=["train", "video", "serve"], default="train",
+        help="train (default; the one-line contract metric), video "
+        "(full-res frame throughput, BASELINE config 5), or serve "
+        "(mixed-resolution directory inference: bucketed vs "
+        "--exact-shapes A/B, docs/SERVING.md)",
     )
     parser.add_argument(
         "--batch-size", type=int, default=4,
@@ -734,9 +852,18 @@ def main():
     )
     args = parser.parse_args()
 
+    # The serve config's contract line fails under its own metric name so
+    # drivers never mistake a dead-tunnel serving bench for a train result;
+    # train and video both keep the historical train-headline fail line.
+    fail_metric = (
+        "mixed_res_dir_images_per_sec"
+        if args.config == "serve"
+        else "uieb_train_images_per_sec_per_chip"
+    )
+
     def _fail(error: str, rc: int = 0):
         line = {
-            "metric": "uieb_train_images_per_sec_per_chip",
+            "metric": fail_metric,
             "value": 0.0,
             "unit": "images/sec/chip",
             "vs_baseline": 0.0,
@@ -746,8 +873,13 @@ def main():
         # session measured this metric on real hardware, attach that result
         # so a dead tunnel doesn't erase on-hardware evidence. Clearly
         # labeled with its capture timestamp; docs/TPU_RESULTS.md has the
-        # full session.
-        prior = _last_measured_headline()
+        # full session. (Train headline only: the serving metric has no
+        # session-report stage yet.)
+        prior = (
+            _last_measured_headline()
+            if fail_metric == "uieb_train_images_per_sec_per_chip"
+            else None
+        )
         if prior is not None:
             line["last_measured_on_hardware"] = prior
         print(json.dumps(line))
@@ -801,6 +933,10 @@ def main():
     if args.config == "video":
         hw = (HW, HW * 16 // 9) if "WATERNET_BENCH_HW" in os.environ else (1080, 1920)
         print(json.dumps(bench_video(hw=hw, batch=args.batch_size, steps=MEASURE_STEPS)))
+        return
+
+    if args.config == "serve":
+        print(json.dumps(bench_serving()))
         return
 
     # Two lines (see module docstring): the strict apples-to-apples host-fed

@@ -16,6 +16,13 @@ TPU-native differences:
   (``--workers``, docs/PIPELINE.md): the next batch's images decode in
   worker threads while the device enhances the current one, with output
   order and batching identical to synchronous decoding;
+* mixed-resolution directories are served through the shape-bucketed
+  dynamic batcher (docs/SERVING.md): at most ``--max-buckets`` compiled
+  executables cover every resolution (inputs pad up, outputs crop back;
+  interior pixels bit-identical to the native forward), batches coalesce
+  across shapes, and every executable is AOT-compiled before the first
+  image — ``--exact-shapes`` restores the historical per-shape batching
+  byte-for-byte; a serving-stats JSON block prints at the end of the run;
 * ``--device-preprocess`` moves WB/GC/CLAHE onto the TPU (tolerance-level
   parity, see waternet_tpu.ops), which is the fast path when host CPU is
   scarce.
@@ -113,7 +120,41 @@ def parse_args(argv=None):
         default=1,
         help="(Optional) Shard each frame batch over N devices (video "
         "throughput scale-out; batches pad to a multiple of N, so use a "
-        "--batch-size that is one for full utilization).",
+        "--batch-size that is a multiple of N for full utilization).",
+    )
+    parser.add_argument(
+        "--exact-shapes",
+        action="store_true",
+        default=False,
+        help="(Optional) Directory sources: keep the historical per-shape "
+        "batching (byte-identical output, one XLA compile per unique "
+        "resolution) instead of the shape-bucketed serving path "
+        "(docs/SERVING.md).",
+    )
+    parser.add_argument(
+        "--serve-buckets",
+        type=str,
+        default="auto",
+        help="(Optional) Compile-bucket ladder for directory sources: "
+        "'auto' (derive from a header-only shape scan of the directory) "
+        "or a comma list like '256,512,1080x1920' (bare N = NxN). Inputs "
+        "pad up to their bucket and outputs crop back; pixels beyond the "
+        "13 px receptive-field radius from the pad seam are bit-identical "
+        "to the native forward (docs/SERVING.md).",
+    )
+    parser.add_argument(
+        "--max-buckets",
+        type=int,
+        default=3,
+        help="(Optional) Ladder size cap for --serve-buckets auto: more "
+        "buckets = less padding but more compiled executables.",
+    )
+    parser.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=20.0,
+        help="(Optional) Bucketed serving: flush a partial batch once its "
+        "oldest image has waited this long (the latency/occupancy dial).",
     )
     return parser.parse_args(argv)
 
@@ -176,18 +217,42 @@ def make_split(bgr_before, bgr_after):
     return composite
 
 
+def _decode_for(path):
+    import cv2
+
+    bgr = cv2.imread(str(path))
+    if bgr is None:
+        return path, None, None
+    return path, bgr, cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+
+
+def _write_output(savedir: Path, path: Path, bgr, out_rgb, show_split: bool):
+    import cv2
+
+    out_bgr = cv2.cvtColor(out_rgb, cv2.COLOR_RGB2BGR)
+    out = make_split(bgr, out_bgr) if show_split else out_bgr
+    savedir.mkdir(parents=True, exist_ok=True)
+    cv2.imwrite(str(savedir / path.name), out)
+
+
 def run_images_batched(
     engine, paths, savedir: Path, show_split: bool, batch_size: int,
     workers: int = 2,
 ):
-    """Enhance a stream of image files with shape-aware batching.
+    """Enhance a stream of image files with exact-shape batching
+    (the ``--exact-shapes`` path; single-file sources also land here).
 
     Consecutive same-shaped images are stacked into device batches of up to
     ``batch_size`` (the common case for datasets like UIEB, where one
     compiled executable then serves every batch); a shape change flushes the
     pending batch, so mixed-resolution directories degrade to the
     reference's one-image-at-a-time behavior (`/root/reference/
-    inference.py:167-233`) rather than recompiling per permutation.
+    inference.py:167-233`) with one XLA compile per unique resolution —
+    the grouping itself lives in
+    :class:`waternet_tpu.serving.ExactShapeBatcher` now, but batches,
+    forwards, and output files are byte-identical to the historical
+    inline implementation. Mixed-resolution streams should prefer the
+    bucketed default (:func:`run_images_bucketed`, docs/SERVING.md).
 
     Decode runs through the overlapped input pipeline (``workers`` threads,
     docs/PIPELINE.md): images for the next batch decode while the device
@@ -195,44 +260,86 @@ def run_images_batched(
     path order regardless of worker scheduling, so batching, grouping, and
     output files are identical to the synchronous path (``workers=0``).
     """
-    import cv2
-
     from waternet_tpu.data.pipeline import OrderedPipeline
+    from waternet_tpu.serving import ExactShapeBatcher
 
-    pending = []  # [(path, bgr, rgb)] — all same shape
+    batcher = ExactShapeBatcher(engine, batch_size)
 
-    def flush():
-        if not pending:
-            return
-        batch = np.stack([rgb for _, _, rgb in pending])
-        outs = engine.enhance(batch)
-        savedir.mkdir(parents=True, exist_ok=True)
-        for (path, bgr, _), out_rgb in zip(pending, outs):
-            out_bgr = cv2.cvtColor(out_rgb, cv2.COLOR_RGB2BGR)
-            out = make_split(bgr, out_bgr) if show_split else out_bgr
-            cv2.imwrite(str(savedir / path.name), out)
-        pending.clear()
+    def write_all(results):
+        for (path, bgr), out_rgb in results:
+            _write_output(savedir, path, bgr, out_rgb, show_split)
 
-    def decode(path):
-        bgr = cv2.imread(str(path))
-        if bgr is None:
-            return path, None, None
-        return path, bgr, cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
-
-    pipe = OrderedPipeline(decode, paths, workers=workers, name="decode")
+    pipe = OrderedPipeline(_decode_for, paths, workers=workers, name="decode")
     try:
         for path, bgr, rgb in pipe:
             if bgr is None:
                 print(f"Skipping unreadable image: {path}", file=sys.stderr)
                 continue
-            if pending and bgr.shape != pending[0][1].shape:
-                flush()
-            pending.append((path, bgr, rgb))
-            if len(pending) >= batch_size:
-                flush()
+            write_all(batcher.push((path, bgr), rgb))
     finally:
         pipe.close()
-    flush()
+    write_all(batcher.flush())
+    return batcher.stats
+
+
+def run_images_bucketed(
+    engine, paths, savedir: Path, show_split: bool, batch_size: int,
+    workers: int = 2, buckets: str = "auto", max_wait_ms: float = 20.0,
+    max_buckets: int = 3,
+):
+    """Enhance a directory through the shape-bucketed serving engine
+    (docs/SERVING.md) — the default for directory sources.
+
+    Every image pads up to its compile bucket and the output crops back,
+    so the whole mixed-resolution stream is served by at most
+    ``len(buckets)`` AOT-warmed executables with full batches, instead of
+    one compile per unique resolution at fragment-batch occupancy.
+    Decode (worker threads), host preprocessing + dispatch (batcher
+    thread), and device->host readback (completion thread) all overlap;
+    outputs are written in path order and the run ends with the serving
+    stats JSON block on stdout.
+    """
+    from collections import deque
+
+    from waternet_tpu.data.pipeline import OrderedPipeline
+    from waternet_tpu.serving import DynamicBatcher, resolve_ladder, scan_shapes
+
+    spec = buckets.strip().lower()
+    ladder = resolve_ladder(
+        buckets, shapes=scan_shapes(paths) if spec == "auto" else None,
+        max_buckets=max_buckets,
+    )
+    print(f"Serving buckets: {', '.join(ladder.describe())} (batch {batch_size})")
+    batcher = DynamicBatcher(
+        engine, ladder, max_batch=batch_size, max_wait_ms=max_wait_ms,
+    )
+    window: deque = deque()  # (path, bgr, future), path order
+
+    def write_head():
+        path, bgr, fut = window.popleft()
+        _write_output(savedir, path, bgr, fut.result(), show_split)
+
+    pipe = OrderedPipeline(_decode_for, paths, workers=workers, name="decode")
+    try:
+        for path, bgr, rgb in pipe:
+            if bgr is None:
+                print(f"Skipping unreadable image: {path}", file=sys.stderr)
+                continue
+            window.append((path, bgr, batcher.submit(rgb)))
+            while window and window[0][2].done():
+                write_head()
+            # Backpressure: never hold more than a few batches of decoded
+            # images + pending results in RAM.
+            while len(window) >= 4 * batch_size:
+                write_head()
+        batcher.drain()
+        while window:
+            write_head()
+    finally:
+        pipe.close()
+        batcher.close()
+    print(batcher.stats.to_json())
+    return batcher.stats
 
 
 def run_video(
@@ -324,17 +431,51 @@ def main(argv=None):
     )
 
     savedir = next_run_dir(Path(__file__).parent / "output", args.name)
-    # Images go through the shape-aware batched runner (same-shaped
-    # directories — the UIEB case — enhance in device batches under one
-    # compiled executable; a single file is just a batch of one). The
-    # reference enhances one image per step (`/root/reference/
+    # Directory image sources ride the shape-bucketed serving engine by
+    # default (mixed resolutions -> at most --max-buckets compiled
+    # executables, full batches, AOT warmup; docs/SERVING.md).
+    # --exact-shapes restores the historical per-shape batching
+    # byte-for-byte; single-file sources are a batch of one either way.
+    # The reference enhances one image per step (`/root/reference/
     # inference.py:166-233`).
     image_files = [f for f in files if f.suffix.lower() in IM_SUFFIXES]
-    if image_files:
-        run_images_batched(
-            engine, image_files, savedir, args.show_split, args.batch_size,
-            workers=args.workers,
+    # Two engine configurations keep the exact-shape path instead of the
+    # bucketed default (pre-PR behavior preserved, noted on stderr):
+    # * sharded engines — the AOT-warmed bucketed executables are lowered
+    #   for unsharded (batch, bucket) shapes, and sharded lowering has
+    #   its own divisibility rules (_validate_shape / _pad_for_shards)
+    #   that bucket padding does not yet negotiate; routing through would
+    #   crash at warmup with a cryptic pjit error;
+    # * --device-preprocess — bucketed serving must compute the global
+    #   per-image WB/GC/CLAHE statistics on the NATIVE image host-side
+    #   (the exactness policy, docs/SERVING.md), which would silently
+    #   defeat the flag's whole point (device preprocessing when host
+    #   CPU is scarce).
+    exact_reason = None
+    if args.data_shards > 1 or args.spatial_shards > 1:
+        exact_reason = "--data-shards/--spatial-shards"
+    elif args.device_preprocess:
+        exact_reason = "--device-preprocess"
+    if exact_reason and not args.exact_shapes and source.is_dir() and image_files:
+        print(
+            f"note: {exact_reason} uses the --exact-shapes directory path "
+            "(bucketed serving is single-chip, host-preprocessed for now, "
+            "docs/SERVING.md)",
+            file=sys.stderr,
         )
+    if image_files:
+        if source.is_dir() and not args.exact_shapes and exact_reason is None:
+            run_images_bucketed(
+                engine, image_files, savedir, args.show_split,
+                args.batch_size, workers=args.workers,
+                buckets=args.serve_buckets, max_wait_ms=args.max_wait_ms,
+                max_buckets=args.max_buckets,
+            )
+        else:
+            run_images_batched(
+                engine, image_files, savedir, args.show_split,
+                args.batch_size, workers=args.workers,
+            )
     for f in files:
         if f.suffix.lower() in VID_SUFFIXES:
             run_video(

@@ -38,81 +38,11 @@ from pprint import pprint
 import numpy as np
 
 
-def _image_shape(path) -> "tuple[int, int, int] | None":
-    """``(h, w, 3)`` from the file header alone — no pixel decode.
-
-    Pass 1 of no-reference scoring only needs shapes to GROUP files; the
-    previous implementation ran ``cv2.imread`` per file, decoding every
-    pixel in the directory twice per scoring run (raw-890 at native
-    resolution is gigabytes). This reads <=64 bytes for PNG/BMP and the
-    marker chain for JPEG — the three containers ``score_no_reference``
-    globs (.png/.jpg/.jpeg/.bmp). Returns ``None`` when the header can't
-    be parsed so the caller falls back to a full decode; channel count is
-    pinned to 3 because ``cv2.imread``'s default flag decodes to 3-channel
-    BGR regardless of the file's own channel count. NOTE: for JPEGs with
-    an EXIF orientation tag cv2 rotates at decode time, so the decoded
-    shape can be the transpose of the header's — the scoring worklist
-    re-queues such files under the decoded shape.
-    """
-    try:
-        with open(path, "rb") as fh:
-            head = fh.read(32)
-            if head[:8] == b"\x89PNG\r\n\x1a\n" and head[12:16] == b"IHDR":
-                w = int.from_bytes(head[16:20], "big")
-                h = int.from_bytes(head[20:24], "big")
-                return (h, w, 3) if h > 0 and w > 0 else None
-            if head[:2] == b"BM" and len(head) >= 26:
-                # BITMAPINFOHEADER: int32 width/height at 18/22; height<0
-                # means top-down row order, same pixel dimensions.
-                w = int.from_bytes(head[18:22], "little", signed=True)
-                h = int.from_bytes(head[22:26], "little", signed=True)
-                return (abs(h), abs(w), 3) if h != 0 and w > 0 else None
-            if head[:2] == b"\xff\xd8":  # JPEG: walk markers to SOFn
-                fh.seek(2)
-                while True:
-                    b = fh.read(1)
-                    if not b:
-                        return None
-                    if b != b"\xff":
-                        continue
-                    marker = fh.read(1)
-                    while marker == b"\xff":  # legal fill bytes
-                        marker = fh.read(1)
-                    if not marker:
-                        return None
-                    m = marker[0]
-                    # Standalone markers (no length field): TEM, RSTn, SOI.
-                    if m == 0x01 or 0xD0 <= m <= 0xD8:
-                        continue
-                    if m == 0xD9:  # EOI before any SOF
-                        return None
-                    if m == 0xDA:
-                        # SOS before any SOF: what follows is
-                        # entropy-coded data where 0xFF bytes are
-                        # stuffing/restart markers, not a marker chain —
-                        # walking on can "find" a fake SOF and return a
-                        # garbage shape. Give up; the caller falls back
-                        # to a full decode.
-                        return None
-                    seg = fh.read(2)
-                    if len(seg) < 2:
-                        return None
-                    seglen = int.from_bytes(seg, "big")
-                    if seglen < 2:
-                        return None
-                    # SOF0..SOF15 carry the frame size; C4/C8/CC are
-                    # DHT/JPG/DAC, not frame headers.
-                    if 0xC0 <= m <= 0xCF and m not in (0xC4, 0xC8, 0xCC):
-                        sof = fh.read(5)
-                        if len(sof) < 5:
-                            return None
-                        h = int.from_bytes(sof[1:3], "big")
-                        w = int.from_bytes(sof[3:5], "big")
-                        return (h, w, 3) if h > 0 and w > 0 else None
-                    fh.seek(seglen - 2, 1)
-    except OSError:
-        return None
-    return None
+# Shared with the serving layer's bucket auto-derivation
+# (waternet_tpu/serving/bucketing.py); kept under its historical private
+# name here — this CLI is the parser's original home and its tests live
+# in tests/test_score.py.
+from waternet_tpu.utils.imagemeta import image_shape as _image_shape  # noqa: E402
 
 
 def parse_args(argv=None):

@@ -29,6 +29,16 @@ def test_engine_enhance_shapes(engine, sample_rgb):
     assert out.dtype == np.uint8
 
 
+def test_engine_enhance_async_empty_batch_raises(engine):
+    """An empty batch used to die in zip(*()) with an opaque 'not enough
+    values to unpack' deep in the host-preprocess path; it must be a
+    clear ValueError at the entry point instead."""
+    with pytest.raises(ValueError, match="empty batch"):
+        engine.enhance_async(np.zeros((0, 8, 8, 3), np.uint8))
+    with pytest.raises(ValueError, match="empty batch"):
+        engine.enhance(np.zeros((0, 8, 8, 3), np.uint8))
+
+
 def test_engine_device_vs_host_preprocess_close(random_params, sample_rgb):
     from waternet_tpu.inference_engine import InferenceEngine
 
@@ -255,11 +265,13 @@ def test_cli_image_roundtrip(random_params, tmp_path, monkeypatch, sample_rgb):
 def test_cli_directory_batches_images_by_shape(
     random_params, tmp_path, monkeypatch, rng
 ):
-    """Directory image sources run through the shape-aware batched path:
+    """--exact-shapes directory sources run through the historical
+    shape-aware batched path (now ExactShapeBatcher, waternet_tpu/serving):
     consecutive same-shaped files stack into device batches of up to
     --batch-size, a shape change flushes the pending batch, and unreadable
     files are skipped without killing the run (reference behavior is one
-    image per step: /root/reference/inference.py:166-233)."""
+    image per step: /root/reference/inference.py:166-233). The bucketed
+    default path has its own suite in tests/test_serving.py."""
     cv2 = pytest.importorskip("cv2")
 
     from waternet_tpu.inference_engine import InferenceEngine
@@ -298,7 +310,8 @@ def test_cli_directory_batches_images_by_shape(
         lambda base, name=None: tmp_path / "out",
     )
     cli.main(
-        ["--source", str(src), "--weights", str(weights), "--batch-size", "2"]
+        ["--source", str(src), "--weights", str(weights), "--batch-size", "2",
+         "--exact-shapes"]
     )
 
     for name, shape in (

@@ -1,0 +1,595 @@
+"""Shape-bucketed serving engine: ladder math, pad exactness (interior
+bit-identity + border PSNR floor), compile-count discipline (sentinel-
+pinned), dynamic batcher semantics, CLI wiring, and the bench A/B line.
+
+The exactness policy under test (docs/SERVING.md): padding is bottom/
+right only, so every output pixel farther than RECEPTIVE_RADIUS = 13 px
+from the pad seam is **bit-identical** to the native-shape forward; the
+seam band is reflect-padded and PSNR-bounded. ``--exact-shapes``
+preserves the historical per-shape behavior byte-for-byte.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from waternet_tpu.serving import (
+    RECEPTIVE_RADIUS,
+    BucketLadder,
+    DynamicBatcher,
+    ExactShapeBatcher,
+    derive_buckets,
+    pad_to_bucket,
+    padding_overhead,
+    parse_buckets,
+    scan_shapes,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: Conservative floor for the reflect-padded seam band (uint8 PSNR vs the
+#: native forward). Measured ~28 dB with random params; real weights are
+#: smoother. The policy is "bounded", the pin is "never worse than this".
+BORDER_PSNR_FLOOR_DB = 20.0
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    from waternet_tpu.models import WaterNet
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    return InferenceEngine(params=params)
+
+
+@pytest.fixture(scope="module")
+def mixed_images(rng):
+    """Eight images over six unique shapes, all covered by a 2-bucket
+    ladder (40x52 and 64x64 class)."""
+    shapes = [(40, 52), (48, 60), (64, 64), (30, 30), (33, 41), (64, 50),
+              (40, 52), (64, 64)]
+    return [
+        np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+        for h, w in shapes
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Bucketing math
+# ---------------------------------------------------------------------------
+
+
+def test_receptive_radius_matches_spatial_halo():
+    """One number, two subsystems: the serving exactness band and the
+    spatial-sharding halo exchange both rest on WaterNet's 13 px
+    receptive-field radius. If the model spec changes, both must move."""
+    from waternet_tpu.parallel.spatial import HALO
+
+    assert RECEPTIVE_RADIUS == HALO == 13
+
+
+def test_parse_buckets_and_selection():
+    ladder = parse_buckets("512, 256, 1080x1920")
+    assert ladder.buckets == [(256, 256), (512, 512), (1080, 1920)]
+    assert ladder.bucket_for(200, 256) == (256, 256)
+    assert ladder.bucket_for(257, 100) == (512, 512)  # H overflows the 256
+    assert ladder.bucket_for(1000, 1900) == (1080, 1920)
+    assert ladder.bucket_for(1081, 8) is None  # overflows every bucket
+    with pytest.raises(ValueError, match="bad bucket"):
+        parse_buckets("256,huge")
+    with pytest.raises(ValueError, match="at least one"):
+        parse_buckets(" , ")
+
+
+def test_derive_buckets_covers_and_minimizes():
+    # Two tight clusters -> with k=2 each cluster gets its own bucket.
+    shapes = [(30, 40), (32, 38), (31, 41), (100, 120), (98, 124), (101, 119)]
+    ladder = derive_buckets(shapes, max_buckets=2)
+    assert len(ladder) == 2
+    for h, w in shapes:
+        bh, bw = ladder.bucket_for(h, w)
+        assert bh >= h and bw >= w
+    assert ladder.buckets == [(32, 41), (101, 124)]
+    # One bucket must be the global elementwise max.
+    one = derive_buckets(shapes, max_buckets=1)
+    assert one.buckets == [(101, 124)]
+    # More buckets never increase padding.
+    assert padding_overhead(shapes, ladder) < padding_overhead(shapes, one)
+    # Never more buckets than unique shapes.
+    assert len(derive_buckets([(8, 8)], max_buckets=3)) == 1
+
+
+def test_pad_to_bucket_reflect_and_edge():
+    img = np.arange(4 * 3 * 3, dtype=np.uint8).reshape(4, 3, 3)
+    out = pad_to_bucket(img, 6, 5)
+    assert out.shape == (6, 5, 3)
+    # Original content keeps the top-left corner (the exactness policy).
+    np.testing.assert_array_equal(out[:4, :3], img)
+    # Reflect: row 4 mirrors row 2 (seam row 3 not repeated).
+    np.testing.assert_array_equal(out[4, :3], img[2])
+    np.testing.assert_array_equal(out[:4, 3], img[:, 1])
+    # Pad wider than the image falls back to edge replication.
+    big = pad_to_bucket(img, 16, 3)
+    np.testing.assert_array_equal(big[10], img[3])
+    with pytest.raises(ValueError, match="does not fit"):
+        pad_to_bucket(img, 3, 3)
+    assert pad_to_bucket(img, 4, 3) is img  # exact fit: no copy
+
+
+def _exif_jpeg_bytes(h: int, w: int, orientation: int) -> bytes:
+    """A minimal JPEG header chain: SOI + APP1(Exif, orientation) + SOF0.
+    Enough for the header parser; not decodable (the parser never needs
+    entropy data)."""
+    tiff = (
+        b"II" + (42).to_bytes(2, "little") + (8).to_bytes(4, "little")
+        + (1).to_bytes(2, "little")  # one IFD0 entry
+        + (0x0112).to_bytes(2, "little") + (3).to_bytes(2, "little")
+        + (1).to_bytes(4, "little") + orientation.to_bytes(2, "little")
+        + b"\x00\x00" + (0).to_bytes(4, "little")
+    )
+    exif = b"Exif\x00\x00" + tiff
+    app1 = b"\xff\xe1" + (len(exif) + 2).to_bytes(2, "big") + exif
+    sof = (
+        b"\xff\xc0" + (11).to_bytes(2, "big") + b"\x08"
+        + h.to_bytes(2, "big") + w.to_bytes(2, "big") + b"\x01\x11\x00"
+    )
+    return b"\xff\xd8" + app1 + sof
+
+
+@pytest.mark.parametrize(
+    "orientation,expect", [(1, (30, 40, 3)), (3, (30, 40, 3)),
+                           (6, (40, 30, 3)), (8, (40, 30, 3))]
+)
+def test_image_shape_honors_exif_orientation(tmp_path, orientation, expect):
+    """Portrait phone JPEGs (EXIF 5-8) decode transposed vs their SOF
+    header; the header parser must report the DECODED shape or the auto
+    bucket ladder covers the wrong orientation and every such image
+    silently takes the per-shape fallback (the pathology bucketing
+    removes)."""
+    from waternet_tpu.utils.imagemeta import image_shape
+
+    f = tmp_path / f"o{orientation}.jpg"
+    f.write_bytes(_exif_jpeg_bytes(30, 40, orientation))
+    assert image_shape(f) == expect
+
+
+def test_scan_shapes_headers_and_skips_unreadable(tmp_path, rng):
+    cv2 = pytest.importorskip("cv2")
+
+    for name, h, w in (("a.png", 30, 40), ("b.jpg", 50, 60)):
+        im = np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+        cv2.imwrite(str(tmp_path / name), im)
+    (tmp_path / "broken.png").write_bytes(b"not a png")
+    shapes = scan_shapes(sorted(tmp_path.glob("*")))
+    assert shapes == [(30, 40), (50, 60)]
+
+
+# ---------------------------------------------------------------------------
+# Exactness policy (pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_interior_bit_identical_and_border_psnr_bounded(engine, rng):
+    """The acceptance pin: pixels beyond the receptive-field radius from
+    the pad seam are bit-identical to the native-shape forward; the seam
+    band holds a PSNR floor."""
+    h, w = 50, 62
+    img = np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+    native = engine.enhance(img[None])[0]
+
+    ladder = BucketLadder([(64, 80)])
+    with DynamicBatcher(engine, ladder, max_batch=2, max_wait_ms=5) as b:
+        (bucketed,) = b.map_ordered([img])
+    assert bucketed.shape == native.shape
+
+    r = RECEPTIVE_RADIUS
+    np.testing.assert_array_equal(
+        bucketed[: h - r, : w - r], native[: h - r, : w - r]
+    )
+    band = np.ones((h, w), bool)
+    band[: h - r, : w - r] = False
+    diff = (
+        bucketed.astype(np.float64)[band] - native.astype(np.float64)[band]
+    )
+    mse = float((diff**2).mean())
+    psnr = 10 * np.log10(255.0**2 / max(mse, 1e-12))
+    assert psnr >= BORDER_PSNR_FLOOR_DB, f"seam-band PSNR {psnr:.1f} dB"
+
+
+def test_bucketed_output_independent_of_batchmates(engine, mixed_images):
+    """A request's output never depends on how it coalesced: the same
+    image served alone and served inside a mixed full batch is
+    bit-identical (conv forward is per-sample independent; batch padding
+    repeats the last image). This is what makes deadline-timing
+    variations unobservable in outputs — the determinism argument."""
+    ladder = derive_buckets([im.shape[:2] for im in mixed_images], 2)
+    with DynamicBatcher(engine, ladder, max_batch=4, max_wait_ms=5) as b:
+        together = b.map_ordered(mixed_images)
+    with DynamicBatcher(engine, ladder, max_batch=4, max_wait_ms=5) as b:
+        alone = [b.map_ordered([im])[0] for im in mixed_images]
+    for a, t in zip(alone, together):
+        np.testing.assert_array_equal(a, t)
+
+
+# ---------------------------------------------------------------------------
+# Compile-count discipline (satellite: sentinel-pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_bucketed_stream_compiles_len_buckets_executables(
+    params, mixed_images, compile_sentinel
+):
+    """Mixed-resolution stream through the bucketed path: exactly
+    len(buckets) executables, all built at warmup — the engine's jit
+    cache must not grow by a single entry while serving (a mid-serve
+    recompile is the stall bucketing exists to remove)."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    engine = InferenceEngine(params=params)
+    ladder = derive_buckets([im.shape[:2] for im in mixed_images], 2)
+    assert len(ladder) == 2
+
+    batcher = DynamicBatcher(engine, ladder, max_batch=4, max_wait_ms=5)
+    # Arm AFTER warmup: every executable the stream needs already exists.
+    compile_sentinel.arm(forward=engine._forward)
+    try:
+        outs = batcher.map_ordered(mixed_images)
+    finally:
+        batcher.close()
+    assert len(outs) == len(mixed_images)
+    compile_sentinel.check()  # zero mid-serve jit compiles
+    assert batcher.stats.summary()["compiles"] == len(ladder)
+    assert batcher.stats.summary()["fallback_native_shapes"] == 0
+
+
+def test_exact_shapes_control_compiles_per_shape(params, mixed_images):
+    """Control for the sentinel test: the per-shape mode really does pay
+    one compile per unique resolution on the same stream."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    engine = InferenceEngine(params=params)
+    if not hasattr(engine._forward, "_cache_size"):
+        pytest.skip("this jax version's jit wrapper has no _cache_size()")
+    exact = ExactShapeBatcher(engine, batch_size=4)
+    done = []
+    for i, im in enumerate(mixed_images):
+        done.extend(exact.push(i, im))
+    done.extend(exact.flush())
+    assert len(done) == len(mixed_images)
+    n_unique = len({im.shape for im in mixed_images})
+    assert exact.stats.compiles == n_unique
+    assert engine._forward._cache_size() == n_unique
+
+
+# ---------------------------------------------------------------------------
+# Dynamic batcher semantics
+# ---------------------------------------------------------------------------
+
+
+def test_exact_shape_batcher_matches_legacy_grouping(engine, rng):
+    """The lifted batcher groups exactly like the historical inline code:
+    flush on shape change, flush at the size cap, order preserved."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    shapes_seen = []
+    orig = InferenceEngine.enhance
+
+    def recording(self, frames):
+        shapes_seen.append(tuple(frames.shape))
+        return orig(self, frames)
+
+    imgs = [
+        np.asarray(rng.integers(0, 256, s), dtype=np.uint8)
+        for s in [(32, 32, 3)] * 3 + [(48, 32, 3)] + [(32, 32, 3)]
+    ]
+    try:
+        InferenceEngine.enhance = recording
+        exact = ExactShapeBatcher(engine, batch_size=2)
+        results = []
+        for i, im in enumerate(imgs):
+            results.extend(exact.push(i, im))
+        results.extend(exact.flush())
+    finally:
+        InferenceEngine.enhance = orig
+    # a1+a2 batch (size cap), a3 flushed by b's shape change, then b, c.
+    assert shapes_seen == [
+        (2, 32, 32, 3), (1, 32, 32, 3), (1, 48, 32, 3), (1, 32, 32, 3),
+    ]
+    assert [k for k, _ in results] == list(range(5))
+    for (_, out), im in zip(results, imgs):
+        assert out.shape == im.shape and out.dtype == np.uint8
+
+
+def test_deadline_flushes_partial_batch(engine, rng):
+    """A lone request must not wait forever for batchmates: the
+    max_wait_ms deadline flushes the partial batch (occupancy < 1)."""
+    img = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    with DynamicBatcher(
+        engine, BucketLadder([(32, 32)]), max_batch=4, max_wait_ms=40
+    ) as b:
+        fut = b.submit(img)  # no drain(): only the deadline can flush
+        out = fut.result(timeout=30)
+    assert out.shape == img.shape
+    assert b.stats.summary()["batch_occupancy"] == pytest.approx(0.25)
+
+
+def test_oversize_request_falls_back_to_native_shape(params, rng):
+    """No covering bucket -> native-shape forward through the jit cache;
+    the compile it causes is counted (stats.compiles = warmup + fallback,
+    the schema's 'executables built')."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    engine = InferenceEngine(params=params)
+    img = np.asarray(rng.integers(0, 256, (48, 70, 3)), dtype=np.uint8)
+    with DynamicBatcher(
+        engine, BucketLadder([(32, 32)]), max_batch=2, max_wait_ms=5
+    ) as b:
+        (out,) = b.map_ordered([img])
+        stats = b.stats.summary()
+    native = engine.enhance(img[None])[0]  # after: jit-cache hit
+    np.testing.assert_array_equal(out, native)  # native shape: exact
+    assert stats["fallback_native_shapes"] == 1
+    if hasattr(engine._forward, "_cache_size"):
+        assert stats["compiles"] == 2  # 1 warmup bucket + 1 fallback shape
+
+
+def test_batcher_rejects_bad_input_and_use_after_close(engine):
+    b = DynamicBatcher(engine, BucketLadder([(32, 32)]), max_batch=2)
+    try:
+        with pytest.raises(ValueError, match=r"\(H, W, 3\)"):
+            b.submit(np.zeros((4, 4), np.uint8))
+    finally:
+        b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit(np.zeros((4, 4, 3), np.uint8))
+    b.close()  # idempotent
+
+
+def test_stats_schema_and_latency_percentiles():
+    from waternet_tpu.serving.stats import ServingStats
+
+    s = ServingStats()
+    for ms in (1.0, 2.0, 100.0):
+        s.record_latency(ms / 1e3)
+    s.record_batch(n_real=3, n_slots=4, real_px=300, padded_px=400,
+                   queue_depth=2)
+    s.record_compile(2)
+    lat = s.latency_ms()
+    assert lat["p50"] == pytest.approx(2.0)
+    assert lat["p99"] == pytest.approx(100.0)
+    summary = s.summary()
+    assert summary["batch_occupancy"] == pytest.approx(0.75)
+    assert summary["padding_overhead"] == pytest.approx(0.25)
+    assert set(summary) == {
+        "requests", "batches", "latency_ms", "batch_occupancy",
+        "padding_overhead", "compiles", "fallback_native_shapes",
+        "queue_depth_mean", "queue_depth_max",
+    }
+    json.loads(s.to_json())  # the CLI block is valid JSON
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+
+
+def _write_weights(params, path):
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    save_weights(params, path)
+    return path
+
+
+def test_cli_directory_bucketed_end_to_end(
+    params, tmp_path, monkeypatch, rng, capsys
+):
+    """Default directory behavior: bucketed serving with auto-derived
+    ladder, native-shape outputs for every readable file, unreadable
+    files skipped, and the serving-stats JSON block on stdout."""
+    cv2 = pytest.importorskip("cv2")
+
+    import inference as cli
+
+    weights = _write_weights(params, tmp_path / "w.npz")
+    src = tmp_path / "imgs"
+    src.mkdir()
+    shapes = {
+        "a.png": (32, 32), "b.png": (40, 52), "c.png": (30, 30),
+        "d.png": (52, 40), "e.png": (48, 60),
+    }
+    for name, (h, w) in shapes.items():
+        im = np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+        cv2.imwrite(str(src / name), im)
+    (src / "broken.png").write_bytes(b"not a png")
+
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir",
+        lambda base, name=None: tmp_path / "out",
+    )
+    cli.main(
+        ["--source", str(src), "--weights", str(weights),
+         "--batch-size", "3", "--max-buckets", "2"]
+    )
+    for name, (h, w) in shapes.items():
+        out = cv2.imread(str(tmp_path / "out" / name))
+        assert out is not None and out.shape == (h, w, 3), name
+    assert not (tmp_path / "out" / "broken.png").exists()
+
+    stats_lines = [
+        json.loads(ln)
+        for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith('{"serving_stats"')
+    ]
+    assert len(stats_lines) == 1
+    stats = stats_lines[0]["serving_stats"]
+    assert stats["requests"] == len(shapes)
+    assert stats["compiles"] <= 2  # the --max-buckets cap held
+    assert stats["fallback_native_shapes"] == 0
+    assert stats["latency_ms"]["p50"] > 0
+
+
+def test_cli_exact_shapes_byte_identical_to_legacy(
+    params, tmp_path, monkeypatch, rng
+):
+    """--exact-shapes output files are byte-for-byte what the historical
+    inline grouping produced (reproduced here verbatim as the oracle)."""
+    cv2 = pytest.importorskip("cv2")
+
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    import inference as cli
+
+    weights = _write_weights(params, tmp_path / "w.npz")
+    src = tmp_path / "imgs"
+    src.mkdir()
+    for i, (h, w) in enumerate([(32, 32), (32, 32), (48, 32), (32, 32)]):
+        im = np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+        cv2.imwrite(str(src / f"im{i}.png"), im)
+
+    # The pre-serving algorithm, verbatim (inference.py @ PR 3).
+    def legacy(engine, paths, savedir, batch_size):
+        pending = []
+
+        def flush():
+            if not pending:
+                return
+            batch = np.stack([rgb for _, _, rgb in pending])
+            outs = engine.enhance(batch)
+            savedir.mkdir(parents=True, exist_ok=True)
+            for (path, bgr, _), out_rgb in zip(pending, outs):
+                out_bgr = cv2.cvtColor(out_rgb, cv2.COLOR_RGB2BGR)
+                cv2.imwrite(str(savedir / path.name), out_bgr)
+            pending.clear()
+
+        for path in paths:
+            bgr = cv2.imread(str(path))
+            rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+            if pending and bgr.shape != pending[0][1].shape:
+                flush()
+            pending.append((path, bgr, rgb))
+            if len(pending) >= batch_size:
+                flush()
+        flush()
+
+    paths = sorted(src.glob("*.png"))
+    engine = InferenceEngine(params=params)
+    legacy(engine, paths, tmp_path / "legacy", batch_size=2)
+
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir",
+        lambda base, name=None: tmp_path / "out",
+    )
+    cli.main(
+        ["--source", str(src), "--weights", str(weights),
+         "--batch-size", "2", "--exact-shapes"]
+    )
+    for p in paths:
+        new = (tmp_path / "out" / p.name).read_bytes()
+        old = (tmp_path / "legacy" / p.name).read_bytes()
+        assert new == old, f"{p.name} drifted from pre-serving output"
+
+
+@pytest.mark.parametrize(
+    "flags", [["--data-shards", "2", "--device-preprocess"],
+              ["--device-preprocess"]],
+    ids=["sharded", "device-preprocess"],
+)
+def test_cli_engine_configs_that_keep_exact_path(
+    params, tmp_path, monkeypatch, rng, capsys, flags
+):
+    """Configurations the bucketed path can't serve yet keep the
+    pre-PR exact-shape behavior instead of breaking: sharded engines
+    (bucketed warmup lowers unsharded shapes and would crash) and
+    --device-preprocess (bucketed serving must host-preprocess at native
+    shape, which would silently defeat the flag). Outputs written, no
+    serving_stats block, a note on stderr."""
+    cv2 = pytest.importorskip("cv2")
+
+    import inference as cli
+
+    weights = _write_weights(params, tmp_path / "w.npz")
+    src = tmp_path / "imgs"
+    src.mkdir()
+    for i, (h, w) in enumerate([(32, 32), (32, 32), (40, 48)]):
+        im = np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+        cv2.imwrite(str(src / f"im{i}.png"), im)
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir",
+        lambda base, name=None: tmp_path / "out",
+    )
+    cli.main(
+        ["--source", str(src), "--weights", str(weights),
+         "--batch-size", "3", *flags]
+    )
+    for i in range(3):
+        assert (tmp_path / "out" / f"im{i}.png").exists()
+    captured = capsys.readouterr()
+    assert "serving_stats" not in captured.out
+    assert "--exact-shapes directory path" in captured.err
+
+
+# ---------------------------------------------------------------------------
+# Bench contract (satellite) + CPU A/B acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serving_contract_line_and_ab_win():
+    """The mixed_res_dir_images_per_sec line: schema, compile counts
+    (bucketed bounded by the ladder, exact paying one per unique shape),
+    and the acceptance A/B — bucketing beats per-shape on CPU."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    line = bench.bench_serving(
+        n_images=9, max_batch=3, max_buckets=2, base_hw=28
+    )
+    assert line["metric"] == "mixed_res_dir_images_per_sec"
+    assert line["unit"] == "images/sec/chip"
+    assert line["value"] > 0
+    assert line["n_images"] == 9
+    assert line["unique_shapes"] == 9  # every image its own resolution
+    assert line["compiles_bucketed"] <= 2
+    assert line["compiles_exact"] == 9
+    assert len(line["buckets"]) <= 2
+    assert 0 < line["batch_occupancy"] <= 1
+    assert 0 <= line["padding_overhead"] < 1
+    assert {"p50", "p95", "p99"} <= set(line["latency_ms"])
+    # The acceptance criterion: bucketed beats the per-shape baseline on
+    # a mixed-resolution stream (9 unique compiles vs <= 2).
+    assert line["speedup_vs_exact"] > 1.0, line
+
+
+@pytest.mark.skipif(
+    not Path("/proc/net/tcp").exists(), reason="needs Linux procfs"
+)
+def test_bench_serve_fail_line_keeps_own_metric():
+    """Unreachable hardware in --config serve: rc 0 and the error-carrying
+    contract JSON under the serving metric, not the train headline."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--config", "serve"],
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "axon",
+             "WATERNET_RELAY_PORT": "1"},  # nothing listens on port 1
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "mixed_res_dir_images_per_sec"
+    assert line["value"] == 0.0
+    assert "error" in line
+    assert "last_measured_on_hardware" not in line  # train-only attachment

@@ -180,6 +180,14 @@ class InferenceEngine:
         while the host continues (used for video double-buffering). Call
         :func:`waternet_tpu.utils.tensor.ten2arr` on the result to sync.
         """
+        if len(rgb_batch) == 0:
+            # Without this the host-preprocess path dies in zip(*()) with
+            # "not enough values to unpack" — opaque at three frames'
+            # distance from the caller that built the empty batch.
+            raise ValueError(
+                "enhance_async got an empty batch: enhancement needs at "
+                "least one (H, W, 3) frame"
+            )
         self._validate_shape(rgb_batch)
         rgb_batch, n_real = self._pad_for_shards(rgb_batch)
         if self.device_preprocess:
@@ -192,3 +200,78 @@ class InferenceEngine:
                 to_dev(gc),
             )
         return out[:n_real]
+
+    # ------------------------------------------------------------------
+    # Pad/crop-aware entry points (the shape-bucketed serving path,
+    # waternet_tpu/serving/ + docs/SERVING.md)
+    # ------------------------------------------------------------------
+
+    def preprocess_padded(self, images, bucket_hw, n_slots=None):
+        """Mixed-native-shape uint8 HWC images -> the network's four
+        float32 input batches at one ``bucket_hw`` canvas shape.
+
+        WB/GC/CLAHE are **always host-computed on the native image** here,
+        regardless of ``device_preprocess``: they are global per-image
+        statistics (quantiles, histograms), so computing them on a padded
+        canvas would change every pixel, not just the seam band — the
+        bucketing exactness policy (interior pixels bit-identical to the
+        native forward) only holds when the pad is applied *after* the
+        per-image transforms. Each of (x, wb, he, gc) is then
+        bottom/right padded to ``bucket_hw`` and, when ``n_slots`` is
+        given, the batch is padded to ``n_slots`` by repeating the last
+        image (the conv forward is per-sample independent, so batch
+        padding never changes a real sample's output).
+        """
+        from waternet_tpu.serving.bucketing import pad_to_bucket
+
+        if not images:
+            raise ValueError(
+                "preprocess_padded got no images: serving batches are "
+                "non-empty by construction"
+            )
+        bh, bw = bucket_hw
+        quads = []
+        for im in images:
+            wb, gc, he = transform_np(im)
+            quads.append(
+                tuple(pad_to_bucket(a, bh, bw) for a in (im, wb, he, gc))
+            )
+        if n_slots is not None:
+            if len(quads) > n_slots:
+                raise ValueError(
+                    f"{len(quads)} images exceed the compiled batch of "
+                    f"{n_slots} slots"
+                )
+            quads.extend([quads[-1]] * (n_slots - len(quads)))
+        x, wb, he, gc = (np.stack(arrs) for arrs in zip(*quads))
+        to_dev = lambda a: jnp.asarray(a, jnp.float32) / 255.0
+        return to_dev(x), to_dev(wb), to_dev(he), to_dev(gc)
+
+    def aot_compile_padded(self, n_slots: int, bucket_hw):
+        """AOT-build the forward executable for one (batch, bucket) shape
+        via ``.lower().compile()`` — no dummy batch materialized, nothing
+        inserted into the jit call cache. The serving warmup compiles one
+        of these per bucket at startup so no request ever pays a compile;
+        dispatch then calls the returned executable directly, which is
+        why a mid-serve growth of ``_forward``'s jit cache is a test
+        failure (tests/test_serving.py, compile_sentinel).
+        """
+        bh, bw = bucket_hw
+        sds = jax.ShapeDtypeStruct((n_slots, bh, bw, 3), jnp.float32)
+        return self._forward.lower(self.params, sds, sds, sds, sds).compile()
+
+    def enhance_padded_async(
+        self, images, bucket_hw, n_slots=None, executable=None
+    ):
+        """Launch the bucketed forward for ``images`` without blocking.
+
+        Returns the device float batch at ``bucket_hw`` — callers crop
+        row ``i`` back to ``images[i].shape`` (the serving batcher does;
+        :func:`waternet_tpu.serving.bucketing` documents which cropped
+        pixels are bit-identical to the native forward). ``executable``
+        is an :meth:`aot_compile_padded` product; without one the call
+        goes through the jit cache (compiling on first use per shape).
+        """
+        args = self.preprocess_padded(images, bucket_hw, n_slots)
+        fwd = self._forward if executable is None else executable
+        return fwd(self.params, *args)

@@ -1,0 +1,36 @@
+"""Serving layer (L4.5): throughput-oriented inference over arbitrary
+request streams — shape bucketing, dynamic micro-batching, AOT warmup,
+and serving observability. See docs/SERVING.md.
+"""
+
+from waternet_tpu.serving.batcher import (
+    DynamicBatcher,
+    ExactShapeBatcher,
+    resolve_ladder,
+)
+from waternet_tpu.serving.bucketing import (
+    RECEPTIVE_RADIUS,
+    BucketLadder,
+    derive_buckets,
+    pad_to_bucket,
+    padding_overhead,
+    parse_buckets,
+    scan_shapes,
+)
+from waternet_tpu.serving.stats import ServingStats
+from waternet_tpu.serving.warmup import warmup
+
+__all__ = [
+    "RECEPTIVE_RADIUS",
+    "BucketLadder",
+    "DynamicBatcher",
+    "ExactShapeBatcher",
+    "ServingStats",
+    "derive_buckets",
+    "pad_to_bucket",
+    "padding_overhead",
+    "parse_buckets",
+    "resolve_ladder",
+    "scan_shapes",
+    "warmup",
+]

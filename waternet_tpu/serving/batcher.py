@@ -1,0 +1,392 @@
+"""Dynamic micro-batching over shape buckets, plus the legacy exact-shape
+batcher the inference CLI used before this subsystem existed.
+
+:class:`DynamicBatcher` is the serving engine's core: a request queue
+with ``max_batch`` / ``max_wait_ms`` deadlines that coalesces concurrent
+requests per compile bucket and keeps the device fed through
+``enhance_padded_async`` double-buffering — the dispatcher thread
+host-preprocesses and launches batch N+1 while the completion thread
+syncs batch N's device->host readback, the same H2D / compute / D2H
+overlap discipline as :class:`waternet_tpu.data.pipeline.OrderedPipeline`.
+Results are delivered through per-request futures, so output ordering is
+whatever the caller makes it; consuming futures in submission order
+(:meth:`DynamicBatcher.map_ordered`, the CLI path) is deterministic
+regardless of how requests happened to coalesce into batches, because the
+conv forward is per-sample independent — a request's output never depends
+on its batchmates (pinned in tests/test_serving.py).
+
+Batches are padded up to the compiled ``max_batch`` slot count (last
+image repeated) so every bucket is served by exactly ONE executable —
+that is what bounds the stream's compile count at ``len(buckets)``.
+Occupancy (real requests / slots) is the price, reported per run by
+:class:`waternet_tpu.serving.stats.ServingStats`.
+
+Worker threads run under the input pipeline's ``THREAD_PREFIX`` so the
+test suite's thread-leak guard (tests/conftest.py) covers serving
+shutdown bugs too.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.serving.bucketing import BucketLadder
+from waternet_tpu.serving.stats import ServingStats
+from waternet_tpu.serving.warmup import warmup as _warmup
+from waternet_tpu.utils.tensor import ten2arr
+
+_CLOSE = object()
+_TICK = object()
+
+
+def _forward_cache_size(engine) -> int:
+    """Size of the engine forward's jit executable cache, 0 when this jax
+    build exposes no introspection — the one probe both batchers use to
+    count real compiles (growth across a call = executables built)."""
+    sizer = getattr(engine._forward, "_cache_size", None)
+    return sizer() if callable(sizer) else 0
+
+
+class _Request:
+    __slots__ = ("image", "future", "t_submit", "t_admit")
+
+    def __init__(self, image: np.ndarray):
+        self.image = image
+        self.future: Future = Future()
+        # t_submit anchors the reported request latency; t_admit (set when
+        # the dispatcher moves the request into its bucket's pending list)
+        # anchors the max_wait deadline — the knob bounds time spent
+        # WAITING FOR BATCHMATES, not queueing delay, which under overload
+        # is capacity-bound and shared by all traffic.
+        self.t_submit = time.perf_counter()
+        self.t_admit = self.t_submit
+
+
+class DynamicBatcher:
+    """Coalesce an arbitrary request stream into full, bucket-shaped
+    device batches behind AOT-compiled executables.
+
+    * ``max_batch`` — compiled batch-slot count per bucket (with
+      ``data_shards`` engines, make it a multiple of the shard count);
+    * ``max_wait_ms`` — once a bucket's oldest admitted request has
+      waited this long for batchmates, the partial batch flushes: the
+      latency/occupancy dial. The clock starts at dispatcher admission,
+      so it bounds coalescing delay specifically — queueing delay under
+      overload is capacity-bound and shared by all traffic;
+    * oversize requests (no covering bucket) fall back to a per-shape
+      native forward through the jit cache and are counted in
+      ``stats.fallback_native_shapes`` — they pay the compile the ladder
+      could not absorb.
+    """
+
+    def __init__(
+        self,
+        engine,
+        ladder: BucketLadder,
+        max_batch: int = 8,
+        max_wait_ms: float = 10.0,
+        stats: Optional[ServingStats] = None,
+        warmup_verbose: bool = False,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.engine = engine
+        self.ladder = ladder
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.stats = stats if stats is not None else ServingStats()
+        # No request ever pays a compile: the whole executable grid is
+        # built before the first submit is accepted.
+        self._executables = _warmup(
+            engine, ladder, [self.max_batch], stats=self.stats,
+            verbose=warmup_verbose,
+        )
+        self._requests: queue.Queue = queue.Queue()
+        # Bounded in-flight window: the dispatcher preprocesses/launches
+        # at most 2 batches ahead of the completion thread's D2H sync —
+        # double buffering, same discipline as the video path.
+        self._inflight: queue.Queue = queue.Queue(maxsize=2)
+        self._closed = False
+        # Makes the closed-check + enqueue atomic vs close(): without it a
+        # racing submit() could land its request BEHIND the _CLOSE
+        # sentinel, where the dispatcher never looks — the caller would
+        # block forever on a future that cannot resolve.
+        self._submit_lock = threading.Lock()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop,
+            name=f"{THREAD_PREFIX}-serve-dispatch",
+            daemon=True,
+        )
+        self._completer = threading.Thread(
+            target=self._complete_loop,
+            name=f"{THREAD_PREFIX}-serve-complete",
+            daemon=True,
+        )
+        self._dispatcher.start()
+        self._completer.start()
+
+    # -- public API ----------------------------------------------------
+
+    def submit(self, image: np.ndarray) -> Future:
+        """Queue one (H, W, 3) uint8 image; resolves to its enhanced
+        native-shape uint8 array. Thread-safe."""
+        if image.ndim != 3 or image.shape[-1] != 3:
+            raise ValueError(
+                f"expected one (H, W, 3) image, got shape {image.shape}"
+            )
+        req = _Request(image)
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("DynamicBatcher is closed")
+            self._requests.put(req)
+        return req.future
+
+    def map_ordered(self, images: Iterable[np.ndarray]) -> List[np.ndarray]:
+        """Submit everything, then collect results in submission order —
+        the deterministic whole-stream entry point (bench A/B uses it)."""
+        futures = [self.submit(im) for im in images]
+        self.drain()
+        return [f.result() for f in futures]
+
+    def drain(self) -> None:
+        """Flush all pending partial batches without closing: everything
+        submitted before the call resolves without waiting out deadlines."""
+        self._requests.put(_TICK)
+
+    def close(self) -> None:
+        """Flush pending requests, stop both workers, join them.
+        Idempotent; safe from ``finally``."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._requests.put(_CLOSE)
+        self._dispatcher.join(timeout=60.0)
+        self._completer.join(timeout=60.0)
+
+    def __enter__(self) -> "DynamicBatcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- dispatcher ----------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        pending: dict = {}  # bucket -> [requests, FIFO]
+
+        def flush_all():
+            for bucket in list(pending):
+                self._flush(bucket, pending.pop(bucket))
+
+        try:
+            while True:
+                timeout = self._next_deadline(pending)
+                try:
+                    item = self._requests.get(timeout=timeout)
+                except queue.Empty:
+                    item = None  # a deadline expired while the queue was idle
+                if item is _CLOSE:
+                    flush_all()
+                    break
+                if item is _TICK:
+                    flush_all()
+                    continue
+                if item is not None:
+                    self._admit(item, pending)
+                    self._sweep(pending)
+                # Coalescing-friendly burst drain: admit everything that
+                # was already queued when this cycle started, so a burst
+                # forms full batches instead of deadline-split fragments
+                # (burst admits are microseconds apart, far inside any
+                # real wait budget, so the per-admit sweep stays quiet).
+                # BOUNDED by the qsize snapshot — items arriving during
+                # the drain's inline flushes wait for the next cycle.
+                # Sweeping after every admit means sustained traffic in
+                # OTHER buckets cannot hold a sparse bucket's request
+                # past its wait budget by more than ~one batch dispatch.
+                closing = False
+                for _ in range(self._requests.qsize()):
+                    try:
+                        nxt = self._requests.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is _CLOSE:
+                        closing = True
+                        break
+                    if nxt is _TICK:
+                        flush_all()
+                        continue
+                    self._admit(nxt, pending)
+                    self._sweep(pending)
+                if closing:
+                    flush_all()
+                    break
+                self._sweep(pending)  # idle-queue cycles: deadlines fire here
+        finally:
+            self._inflight.put(_CLOSE)
+
+    def _admit(self, req: _Request, pending: dict) -> None:
+        req.t_admit = time.perf_counter()
+        h, w = req.image.shape[:2]
+        bucket = self.ladder.bucket_for(h, w)
+        pending.setdefault(bucket, []).append(req)
+        if bucket is None or len(pending[bucket]) >= self.max_batch:
+            self._flush(bucket, pending.pop(bucket))
+
+    def _sweep(self, pending: dict) -> None:
+        """Flush every bucket whose oldest ADMITTED request has waited out
+        the max_wait budget (cheap: O(buckets) clock checks)."""
+        now = time.perf_counter()
+        for bucket in list(pending):
+            reqs = pending[bucket]
+            if reqs and now - reqs[0].t_admit >= self.max_wait_s:
+                self._flush(bucket, pending.pop(bucket))
+
+    def _next_deadline(self, pending: dict) -> Optional[float]:
+        oldest = None
+        for reqs in pending.values():
+            if reqs:
+                t = reqs[0].t_admit
+                oldest = t if oldest is None else min(oldest, t)
+        if oldest is None:
+            return None  # idle: block until the next request
+        return max(0.0, oldest + self.max_wait_s - time.perf_counter())
+
+    def _flush(self, bucket, reqs: List[_Request]) -> None:
+        if not reqs:
+            return
+        try:
+            if bucket is None:
+                # Oversize for every bucket: native-shape forwards, one
+                # request each (mixed oversize shapes cannot stack). These
+                # go through the jit cache, so any compile they cause is
+                # real — count it (stats.compiles is "executables built",
+                # warmup AND fallback; the bench line reports it).
+                for r in reqs:
+                    self.stats.record_fallback()
+                    before = _forward_cache_size(self.engine)
+                    out = self.engine.enhance_async(r.image[None])
+                    grew = _forward_cache_size(self.engine) - before
+                    if grew > 0:
+                        self.stats.record_compile(grew)
+                    self._inflight.put((out, [r]))
+                return
+            exe = self._executables[(bucket, self.max_batch)]
+            images = [r.image for r in reqs]
+            out = self.engine.enhance_padded_async(
+                images, bucket, n_slots=self.max_batch, executable=exe
+            )
+            bh, bw = bucket
+            self.stats.record_batch(
+                n_real=len(reqs),
+                n_slots=self.max_batch,
+                real_px=sum(im.shape[0] * im.shape[1] for im in images),
+                padded_px=self.max_batch * bh * bw,
+                queue_depth=self._requests.qsize(),
+            )
+            self._inflight.put((out, reqs))
+        except BaseException as err:
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(err)
+
+    # -- completion ----------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._inflight.get()
+            if item is _CLOSE:
+                return
+            out_dev, reqs = item
+            try:
+                arr = ten2arr(out_dev)  # the batch's one D2H sync
+            except BaseException as err:
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+                continue
+            t_done = time.perf_counter()
+            for i, r in enumerate(reqs):
+                h, w = r.image.shape[:2]
+                r.future.set_result(arr[i, :h, :w])
+                self.stats.record_latency(t_done - r.t_submit)
+
+
+class ExactShapeBatcher:
+    """The pre-serving shape-aware grouping, lifted verbatim from
+    ``inference.run_images_batched``: consecutive same-shaped images
+    stack into device batches of up to ``batch_size``; a shape change
+    flushes the pending batch; forwards go through the engine's jit
+    cache, compiling once per unique shape. This is the CLI's
+    ``--exact-shapes`` path — byte-for-byte the historical behavior —
+    and the A/B baseline the bench line measures bucketing against.
+    """
+
+    def __init__(self, engine, batch_size: int, stats: Optional[ServingStats] = None):
+        self.engine = engine
+        self.batch_size = int(batch_size)
+        self.stats = stats if stats is not None else ServingStats()
+        self._pending: List[Tuple[object, np.ndarray, float]] = []
+
+    def push(self, key, image: np.ndarray) -> List[Tuple[object, np.ndarray]]:
+        """Add one image; returns any (key, enhanced) results this push
+        flushed, in submission order (possibly two groups: the
+        shape-change flush then the size-cap flush)."""
+        flushed: List[Tuple[object, np.ndarray]] = []
+        if self._pending and image.shape != self._pending[0][1].shape:
+            flushed.extend(self.flush())
+        self._pending.append((key, image, time.perf_counter()))
+        if len(self._pending) >= self.batch_size:
+            flushed.extend(self.flush())
+        return flushed
+
+    def flush(self) -> List[Tuple[object, np.ndarray]]:
+        if not self._pending:
+            return []
+        images = [im for _, im, _ in self._pending]
+        before = _forward_cache_size(self.engine)
+        outs = self.engine.enhance(np.stack(images))
+        grew = _forward_cache_size(self.engine) - before
+        if grew > 0:
+            self.stats.record_compile(grew)
+        h, w = images[0].shape[:2]
+        self.stats.record_batch(
+            n_real=len(images),
+            n_slots=self.batch_size,
+            real_px=len(images) * h * w,
+            padded_px=len(images) * h * w,  # exact shapes: zero padding
+        )
+        t_done = time.perf_counter()
+        results = [(k, out) for (k, _, _), out in zip(self._pending, outs)]
+        # Latency is push -> result ready, the same submit-anchored metric
+        # DynamicBatcher records — the two batchers' stats are comparable.
+        for _, _, t_push in self._pending:
+            self.stats.record_latency(t_done - t_push)
+        self._pending.clear()
+        return results
+
+
+def resolve_ladder(
+    spec: str,
+    shapes: Optional[Sequence[Tuple[int, int]]] = None,
+    max_buckets: int = 3,
+) -> BucketLadder:
+    """CLI-facing ladder resolution: ``"auto"`` derives from the scanned
+    ``shapes`` (falling back to the default square ladder when no shapes
+    are known), anything else parses as an explicit bucket list."""
+    from waternet_tpu.serving.bucketing import derive_buckets, parse_buckets
+
+    if spec.strip().lower() == "auto":
+        if shapes:
+            return derive_buckets(shapes, max_buckets=max_buckets)
+        return parse_buckets("256,512,1080x1920")
+    return parse_buckets(spec)

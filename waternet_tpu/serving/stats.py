@@ -1,0 +1,136 @@
+"""Serving observability: latency percentiles, occupancy, padding, compiles.
+
+One :class:`ServingStats` instance rides a batcher for its whole life;
+every number it reports is also a bench contract field
+(``mixed_res_dir_images_per_sec``, bench.py) and the CLI's end-of-run
+JSON stats block — the schema is documented in docs/SERVING.md and
+pinned by tests/test_serving.py.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+from typing import Dict, List
+
+#: Latency reservoir size: percentiles are computed over at most this many
+#: uniformly-sampled requests (algorithm R), so a long-lived server's
+#: stats stay O(1) memory instead of one float per request forever.
+LATENCY_RESERVOIR = 65536
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingStats:
+    """Thread-safe accumulators for the serving layer.
+
+    * per-request **latency** (submit -> result ready), reported as
+      p50/p95/p99 milliseconds;
+    * **queue depth** observed by the dispatcher at each batch launch;
+    * **batch occupancy**: real requests / device batch slots (padding
+      a partial batch up to the compiled batch size keeps the executable
+      count bounded but burns slots — occupancy is that cost);
+    * **padding overhead**: 1 - real pixels / padded-canvas pixels
+      (the price of serving a shape from a bucket larger than it);
+    * **compiles**: executables built (warmup) + any mid-serve fallback
+      compile (a native-shape forward for an oversize request). A
+      mid-serve compile for a *bucketed* request is a bug — the
+      compile-sentinel test pins that it never happens.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latencies_s: List[float] = []  # bounded reservoir sample
+        self._reservoir_rng = random.Random(0)
+        self.requests = 0
+        self.batches = 0
+        self.real_slots = 0
+        self.total_slots = 0
+        self.real_px = 0
+        self.padded_px = 0
+        self.compiles = 0
+        self.fallback_native = 0
+        self._depth_sum = 0
+        self.depth_max = 0
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self.requests += 1
+            if len(self._latencies_s) < LATENCY_RESERVOIR:
+                self._latencies_s.append(seconds)
+            else:
+                # Algorithm R: every request keeps an equal chance of
+                # being in the sample, at O(1) memory for server
+                # lifetimes of any length.
+                j = self._reservoir_rng.randrange(self.requests)
+                if j < LATENCY_RESERVOIR:
+                    self._latencies_s[j] = seconds
+
+    def record_batch(
+        self, n_real: int, n_slots: int, real_px: int, padded_px: int,
+        queue_depth: int = 0,
+    ) -> None:
+        with self._lock:
+            self.batches += 1
+            self.real_slots += n_real
+            self.total_slots += n_slots
+            self.real_px += real_px
+            self.padded_px += padded_px
+            self._depth_sum += queue_depth
+            self.depth_max = max(self.depth_max, queue_depth)
+
+    def record_compile(self, n: int = 1) -> None:
+        with self._lock:
+            self.compiles += n
+
+    def record_fallback(self) -> None:
+        with self._lock:
+            self.fallback_native += 1
+
+    def occupancy(self) -> float:
+        with self._lock:
+            return self.real_slots / self.total_slots if self.total_slots else 0.0
+
+    def padding_overhead(self) -> float:
+        with self._lock:
+            return 1.0 - self.real_px / self.padded_px if self.padded_px else 0.0
+
+    def latency_ms(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._latencies_s)
+        return {
+            "p50": round(_percentile(vals, 0.50) * 1e3, 3),
+            "p95": round(_percentile(vals, 0.95) * 1e3, 3),
+            "p99": round(_percentile(vals, 0.99) * 1e3, 3),
+        }
+
+    def summary(self) -> dict:
+        """The JSON stats block (docs/SERVING.md schema)."""
+        with self._lock:
+            batches = self.batches
+            depth_mean = self._depth_sum / batches if batches else 0.0
+            depth_max = self.depth_max
+            requests = self.requests
+            compiles = self.compiles
+            fallback = self.fallback_native
+        return {
+            "requests": requests,
+            "batches": batches,
+            "latency_ms": self.latency_ms(),
+            "batch_occupancy": round(self.occupancy(), 4),
+            "padding_overhead": round(self.padding_overhead(), 4),
+            "compiles": compiles,
+            "fallback_native_shapes": fallback,
+            "queue_depth_mean": round(depth_mean, 2),
+            "queue_depth_max": depth_max,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps({"serving_stats": self.summary()})

@@ -1,0 +1,53 @@
+"""AOT warmup: compile every (bucket, batch) executable before serving.
+
+A mid-serve XLA compile is a multi-second stall on the request path — the
+exact pathology bucketing exists to remove — so the batcher refuses to
+rely on jit's compile-on-first-call. At startup this module
+``.lower().compile()``s one executable per (bucket, batch-slot) shape via
+:meth:`InferenceEngine.aot_compile_padded`; dispatch then calls those
+executables directly and the engine's jit cache is never consulted for a
+bucketed request. That makes the no-recompile guarantee *testable*: the
+PR-3 ``compile_sentinel`` fixture arms ``engine._forward`` after warmup
+and any growth during serving fails the test
+(tests/test_serving.py::test_bucketed_stream_compiles_len_buckets_executables).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+from waternet_tpu.serving.bucketing import Bucket, BucketLadder
+from waternet_tpu.serving.stats import ServingStats
+
+
+def warmup(
+    engine,
+    ladder: BucketLadder,
+    batch_sizes: Sequence[int],
+    stats: Optional[ServingStats] = None,
+    verbose: bool = False,
+) -> Dict[Tuple[Bucket, int], object]:
+    """Compile the full (bucket x batch-size) executable grid.
+
+    Returns ``{((bh, bw), n): executable}``; every compile is counted in
+    ``stats`` (the bench contract's ``compiles`` field). With the
+    persistent XLA compile cache enabled (utils/platform.py) repeated
+    server startups deserialize instead of recompiling, but each shape
+    still counts as one executable here — the number the acceptance
+    criterion bounds is executables built, not cache misses.
+    """
+    executables: Dict[Tuple[Bucket, int], object] = {}
+    for bucket in ladder:
+        for n in sorted(set(int(b) for b in batch_sizes)):
+            t0 = time.perf_counter()
+            executables[(bucket, n)] = engine.aot_compile_padded(n, bucket)
+            if stats is not None:
+                stats.record_compile()
+            if verbose:
+                bh, bw = bucket
+                print(
+                    f"serving warmup: compiled {n}x{bh}x{bw} in "
+                    f"{time.perf_counter() - t0:.1f}s"
+                )
+    return executables
