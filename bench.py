@@ -40,6 +40,19 @@ batcher (waternet_tpu/serving/, docs/SERVING.md) against the legacy
 resolution stream, reporting batch occupancy, padding overhead, and the
 compile count of each mode.
 
+``--config serve_multi`` measures the multi-device scale-out instead:
+the ``mixed_res_dir_images_per_sec_multidev`` line serves the same
+shuffled mixed-resolution stream through a 1-replica pool and then an
+N-replica pool (``WATERNET_BENCH_SERVE_REPLICAS``, default every local
+device), reporting the aggregate images/sec, ``speedup_vs_1_replica``,
+per-replica occupancy/latency, load imbalance, and a byte-identity check
+between the two arms (``replica_invariant``). Runnable on the forced
+8-device CPU platform the test suite uses (``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8``) — note virtual CPU
+devices share the host's physical cores, so CPU speedups track the core
+count, not the device count; the near-linear regime is real multi-chip
+hardware.
+
 The last stdout line is the contract JSON:
 {"metric", "value", "unit", "vs_baseline"}. When no hardware is reachable
 the process exits rc 0 with ``value: 0.0`` and an ``error`` field — "no
@@ -217,52 +230,36 @@ def bench_video_device_resident(hw=(1080, 1920), batch=4, steps=12, quantize=Non
     }
 
 
-def bench_serving(
-    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
-):
-    """Mixed-resolution directory-serving throughput: the shape-bucketed
-    dynamic batcher (waternet_tpu/serving/, docs/SERVING.md) A/B'd against
-    the legacy ``--exact-shapes`` per-shape batching on an identical
-    shuffled image population where every image has a unique resolution —
-    the worst case for per-shape compilation, and the realistic case for
-    user-upload traffic. Returns the ``mixed_res_dir_images_per_sec``
-    contract-line dict (value = bucketed throughput, end-to-end including
-    host preprocessing and D2H readback; AOT warmup is reported separately
-    as ``warmup_sec`` because a server pays it once, not per stream).
-    """
+def _serving_params():
     import jax
     import jax.numpy as jnp
 
-    from waternet_tpu.inference_engine import InferenceEngine
     from waternet_tpu.models import WaterNet
-    from waternet_tpu.serving import (
-        DynamicBatcher,
-        ExactShapeBatcher,
-        derive_buckets,
-    )
-
-    n_images = (
-        _env_int("WATERNET_BENCH_SERVE_IMAGES", 48)
-        if n_images is None else n_images
-    )
-    max_batch = (
-        _env_int("WATERNET_BENCH_SERVE_BATCH", 8)
-        if max_batch is None else max_batch
-    )
-    max_buckets = (
-        _env_int("WATERNET_BENCH_SERVE_BUCKETS", 3)
-        if max_buckets is None else max_buckets
-    )
-    base = HW if base_hw is None else base_hw
 
     x = jnp.zeros((1, 16, 16, 3), jnp.float32)
-    params = WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
 
-    # Three resolution classes with per-image jitter, deduplicated so
-    # every image really is its own unique shape (uploads are never
-    # aligned; the per-shape baseline must get zero free jit-cache hits),
-    # shuffled so shapes interleave — consecutive-same-shape grouping
-    # gets no free rides either.
+
+def _serving_env_defaults(n_images, max_batch, max_buckets):
+    """Resolve the serve configs' shared workload knobs: explicit args
+    win, else the WATERNET_BENCH_SERVE_* env defaults — one resolver for
+    both serve configs so their workloads can never silently diverge."""
+    return (
+        _env_int("WATERNET_BENCH_SERVE_IMAGES", 48)
+        if n_images is None else n_images,
+        _env_int("WATERNET_BENCH_SERVE_BATCH", 8)
+        if max_batch is None else max_batch,
+        _env_int("WATERNET_BENCH_SERVE_BUCKETS", 3)
+        if max_buckets is None else max_buckets,
+    )
+
+
+def _serving_population(n_images, base):
+    """The serving benches' shared workload: three resolution classes with
+    per-image jitter, deduplicated so every image really is its own unique
+    shape (uploads are never aligned; the per-shape baseline must get zero
+    free jit-cache hits), shuffled so shapes interleave —
+    consecutive-same-shape grouping gets no free rides either."""
     rng = np.random.default_rng(0)
     shapes = []
     seen = set()
@@ -278,7 +275,36 @@ def bench_serving(
     images = [
         rng.integers(0, 256, (h, w, 3), dtype=np.uint8) for h, w in shapes
     ]
+    return images, shapes
 
+
+def bench_serving(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+):
+    """Mixed-resolution directory-serving throughput: the shape-bucketed
+    dynamic batcher (waternet_tpu/serving/, docs/SERVING.md) A/B'd against
+    the legacy ``--exact-shapes`` per-shape batching on an identical
+    shuffled image population where every image has a unique resolution —
+    the worst case for per-shape compilation, and the realistic case for
+    user-upload traffic. Returns the ``mixed_res_dir_images_per_sec``
+    contract-line dict (value = bucketed throughput, end-to-end including
+    host preprocessing and D2H readback; AOT warmup is reported separately
+    as ``warmup_sec`` because a server pays it once, not per stream).
+    """
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving import (
+        DynamicBatcher,
+        ExactShapeBatcher,
+        derive_buckets,
+    )
+
+    n_images, max_batch, max_buckets = _serving_env_defaults(
+        n_images, max_batch, max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+
+    params = _serving_params()
+    images, shapes = _serving_population(n_images, base)
     ladder = derive_buckets(shapes, max_buckets=max_buckets)
 
     engine = InferenceEngine(params=params)
@@ -323,6 +349,96 @@ def bench_serving(
         "n_images": n_images,
         "unique_shapes": len(set(shapes)),
         "max_batch": max_batch,
+    }
+
+
+def bench_serving_multi(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+    replicas=None,
+):
+    """Multi-device serving scale-out: the same shuffled mixed-resolution
+    population as :func:`bench_serving`, served through a 1-replica pool
+    and then an N-replica pool (waternet_tpu/serving/replicas.py) on the
+    SAME ladder and batch size — the replica-count A/B the tentpole
+    acceptance criterion reads. Returns the
+    ``mixed_res_dir_images_per_sec_multidev`` contract-line dict (value =
+    N-replica aggregate throughput). The two arms' outputs are
+    byte-compared (``replica_invariant``) so every hardware run of the
+    bench re-checks the invariance pin; warmup is reported per arm — the
+    N-replica warmup compiles ``len(buckets) x N`` executables in
+    parallel threads.
+
+    ``host_cpus`` is attached because the CPU rehearsal platform's 8
+    virtual devices share the physical cores: there, speedup tracks
+    cores, not replicas — the near-linear regime needs real chips.
+    """
+    import os as _os
+
+    import jax
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving import DynamicBatcher, derive_buckets
+
+    n_images, max_batch, max_buckets = _serving_env_defaults(
+        n_images, max_batch, max_buckets
+    )
+    n_replicas = (
+        _env_int("WATERNET_BENCH_SERVE_REPLICAS", len(jax.local_devices()))
+        if replicas is None else replicas
+    )
+    n_replicas = max(1, min(n_replicas, len(jax.local_devices())))
+    base = HW if base_hw is None else base_hw
+
+    params = _serving_params()
+    images, shapes = _serving_population(n_images, base)
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+
+    def run(n_rep):
+        engine = InferenceEngine(params=params)
+        t0 = time.perf_counter()
+        batcher = DynamicBatcher(
+            engine, ladder, max_batch=max_batch, replicas=n_rep
+        )
+        warmup_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        outs = batcher.map_ordered(images)
+        serve_s = time.perf_counter() - t0
+        batcher.close()
+        assert len(outs) == n_images
+        return outs, n_images / serve_s, warmup_s, batcher.stats.summary()
+
+    outs_1, ips_1, warmup_1, _ = run(1)
+    outs_n, ips_n, warmup_n, summary = run(n_replicas)
+    invariant = all(
+        np.array_equal(a, b) for a, b in zip(outs_1, outs_n)
+    )
+
+    return {
+        "metric": "mixed_res_dir_images_per_sec_multidev",
+        "value": round(ips_n, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "replicas": n_replicas,
+        "images_per_sec_1replica": round(ips_1, 2),
+        "speedup_vs_1_replica": round(ips_n / ips_1, 2),
+        "replica_invariant": bool(invariant),
+        "buckets": ladder.describe(),
+        "compiles": summary["compiles"],
+        "batch_occupancy": summary["batch_occupancy"],
+        "padding_overhead": summary["padding_overhead"],
+        "fallback_native_shapes": summary["fallback_native_shapes"],
+        "latency_ms": summary["latency_ms"],
+        "load_imbalance": summary["load_imbalance"],
+        "per_replica": summary["per_replica"],
+        "warmup_sec_1replica": round(warmup_1, 1),
+        "warmup_sec": round(warmup_n, 1),
+        "n_images": n_images,
+        "unique_shapes": len(set(shapes)),
+        "max_batch": max_batch,
+        "host_cpus": _os.cpu_count(),
+        "device_kind": getattr(
+            jax.local_devices()[0], "device_kind", "unknown"
+        ),
     }
 
 
@@ -840,11 +956,13 @@ def main():
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
-        "--config", choices=["train", "video", "serve"], default="train",
+        "--config", choices=["train", "video", "serve", "serve_multi"],
+        default="train",
         help="train (default; the one-line contract metric), video "
-        "(full-res frame throughput, BASELINE config 5), or serve "
+        "(full-res frame throughput, BASELINE config 5), serve "
         "(mixed-resolution directory inference: bucketed vs "
-        "--exact-shapes A/B, docs/SERVING.md)",
+        "--exact-shapes A/B, docs/SERVING.md), or serve_multi "
+        "(replica-pool scale-out: N replicas vs 1 on the same stream)",
     )
     parser.add_argument(
         "--batch-size", type=int, default=4,
@@ -852,14 +970,14 @@ def main():
     )
     args = parser.parse_args()
 
-    # The serve config's contract line fails under its own metric name so
-    # drivers never mistake a dead-tunnel serving bench for a train result;
-    # train and video both keep the historical train-headline fail line.
-    fail_metric = (
-        "mixed_res_dir_images_per_sec"
-        if args.config == "serve"
-        else "uieb_train_images_per_sec_per_chip"
-    )
+    # The serve configs' contract lines fail under their own metric names
+    # so drivers never mistake a dead-tunnel serving bench for a train
+    # result; train and video both keep the historical train-headline fail
+    # line.
+    fail_metric = {
+        "serve": "mixed_res_dir_images_per_sec",
+        "serve_multi": "mixed_res_dir_images_per_sec_multidev",
+    }.get(args.config, "uieb_train_images_per_sec_per_chip")
 
     def _fail(error: str, rc: int = 0):
         line = {
@@ -937,6 +1055,10 @@ def main():
 
     if args.config == "serve":
         print(json.dumps(bench_serving()))
+        return
+
+    if args.config == "serve_multi":
+        print(json.dumps(bench_serving_multi()))
         return
 
     # Two lines (see module docstring): the strict apples-to-apples host-fed

@@ -23,9 +23,17 @@ TPU-native differences:
   across shapes, and every executable is AOT-compiled before the first
   image — ``--exact-shapes`` restores the historical per-shape batching
   byte-for-byte; a serving-stats JSON block prints at the end of the run;
+* directory serving drives **every local device by default**
+  (``--serve-replicas auto|N``, docs/SERVING.md "Replica pool"): params
+  and the warmed executable grid are placed on each device, coalesced
+  batches go to the least-loaded replica, and each replica has its own
+  launch/readback threads — outputs are byte-identical at any replica
+  count;
 * ``--device-preprocess`` moves WB/GC/CLAHE onto the TPU (tolerance-level
   parity, see waternet_tpu.ops), which is the fast path when host CPU is
-  scarce.
+  scarce — including on the bucketed directory path, where each replica
+  computes the transforms on-device with native-image-first statistics
+  (waternet_tpu/ops/masked.py).
 """
 
 from __future__ import annotations
@@ -126,10 +134,11 @@ def parse_args(argv=None):
         "--exact-shapes",
         action="store_true",
         default=False,
-        help="(Optional) Directory sources: keep the historical per-shape "
-        "batching (byte-identical output, one XLA compile per unique "
-        "resolution) instead of the shape-bucketed serving path "
-        "(docs/SERVING.md).",
+        help="(Optional) Directory sources: the byte-for-byte escape hatch "
+        "— historical per-shape batching on a single device (output "
+        "byte-identical to the pre-serving CLI, one XLA compile per "
+        "unique resolution) instead of the bucketed replica-pool serving "
+        "path (docs/SERVING.md).",
     )
     parser.add_argument(
         "--serve-buckets",
@@ -155,6 +164,16 @@ def parse_args(argv=None):
         default=20.0,
         help="(Optional) Bucketed serving: flush a partial batch once its "
         "oldest image has waited this long (the latency/occupancy dial).",
+    )
+    parser.add_argument(
+        "--serve-replicas",
+        type=str,
+        default="auto",
+        help="(Optional) Bucketed serving: replica-pool size — 'auto' "
+        "(every local device; sharded engines always serve as one "
+        "mesh-spanning replica) or an explicit N. Each replica holds its "
+        "own params copy and AOT-warmed executables; outputs are "
+        "byte-identical at any replica count (docs/SERVING.md).",
     )
     return parser.parse_args(argv)
 
@@ -285,19 +304,22 @@ def run_images_batched(
 def run_images_bucketed(
     engine, paths, savedir: Path, show_split: bool, batch_size: int,
     workers: int = 2, buckets: str = "auto", max_wait_ms: float = 20.0,
-    max_buckets: int = 3,
+    max_buckets: int = 3, replicas="auto",
 ):
     """Enhance a directory through the shape-bucketed serving engine
     (docs/SERVING.md) — the default for directory sources.
 
     Every image pads up to its compile bucket and the output crops back,
     so the whole mixed-resolution stream is served by at most
-    ``len(buckets)`` AOT-warmed executables with full batches, instead of
-    one compile per unique resolution at fragment-batch occupancy.
-    Decode (worker threads), host preprocessing + dispatch (batcher
-    thread), and device->host readback (completion thread) all overlap;
-    outputs are written in path order and the run ends with the serving
-    stats JSON block on stdout.
+    ``len(buckets)`` AOT-warmed executables per replica with full
+    batches, instead of one compile per unique resolution at
+    fragment-batch occupancy. The replica pool (default: every local
+    device) gives each serving device its own params copy, executables,
+    and launch/readback threads; decode (worker threads), per-replica
+    host preprocessing + dispatch, device compute, and D2H readback all
+    overlap. Outputs are written in path order — byte-identical at any
+    replica count — and the run ends with the serving stats JSON block
+    on stdout.
     """
     from collections import deque
 
@@ -309,9 +331,13 @@ def run_images_bucketed(
         buckets, shapes=scan_shapes(paths) if spec == "auto" else None,
         max_buckets=max_buckets,
     )
-    print(f"Serving buckets: {', '.join(ladder.describe())} (batch {batch_size})")
     batcher = DynamicBatcher(
         engine, ladder, max_batch=batch_size, max_wait_ms=max_wait_ms,
+        replicas=replicas,
+    )
+    print(
+        f"Serving buckets: {', '.join(batcher.ladder.describe())} "
+        f"(batch {batcher.max_batch}, replicas {batcher.n_replicas})"
     )
     window: deque = deque()  # (path, bgr, future), path order
 
@@ -329,8 +355,9 @@ def run_images_bucketed(
             while window and window[0][2].done():
                 write_head()
             # Backpressure: never hold more than a few batches of decoded
-            # images + pending results in RAM.
-            while len(window) >= 4 * batch_size:
+            # images + pending results in RAM — per replica, or a pool of
+            # N devices could never have more than one batch in flight.
+            while len(window) >= 4 * batcher.max_batch * batcher.n_replicas:
                 write_head()
         batcher.drain()
         while window:
@@ -433,43 +460,24 @@ def main(argv=None):
     savedir = next_run_dir(Path(__file__).parent / "output", args.name)
     # Directory image sources ride the shape-bucketed serving engine by
     # default (mixed resolutions -> at most --max-buckets compiled
-    # executables, full batches, AOT warmup; docs/SERVING.md).
-    # --exact-shapes restores the historical per-shape batching
-    # byte-for-byte; single-file sources are a batch of one either way.
-    # The reference enhances one image per step (`/root/reference/
-    # inference.py:166-233`).
+    # executables per replica, full batches, AOT warmup, every local
+    # device driven; docs/SERVING.md). Sharded engines serve as one
+    # mesh-spanning replica (the ladder rounds bucket heights to the
+    # spatial grid; slot counts round to the data-shard multiple), and
+    # --device-preprocess engines run WB/GC/CLAHE on device per replica
+    # with native-image-first statistics (waternet_tpu/ops/masked.py).
+    # --exact-shapes is the byte-for-byte escape hatch (historical
+    # per-shape batching); single-file sources are a batch of one either
+    # way. The reference enhances one image per step
+    # (`/root/reference/inference.py:166-233`).
     image_files = [f for f in files if f.suffix.lower() in IM_SUFFIXES]
-    # Two engine configurations keep the exact-shape path instead of the
-    # bucketed default (pre-PR behavior preserved, noted on stderr):
-    # * sharded engines — the AOT-warmed bucketed executables are lowered
-    #   for unsharded (batch, bucket) shapes, and sharded lowering has
-    #   its own divisibility rules (_validate_shape / _pad_for_shards)
-    #   that bucket padding does not yet negotiate; routing through would
-    #   crash at warmup with a cryptic pjit error;
-    # * --device-preprocess — bucketed serving must compute the global
-    #   per-image WB/GC/CLAHE statistics on the NATIVE image host-side
-    #   (the exactness policy, docs/SERVING.md), which would silently
-    #   defeat the flag's whole point (device preprocessing when host
-    #   CPU is scarce).
-    exact_reason = None
-    if args.data_shards > 1 or args.spatial_shards > 1:
-        exact_reason = "--data-shards/--spatial-shards"
-    elif args.device_preprocess:
-        exact_reason = "--device-preprocess"
-    if exact_reason and not args.exact_shapes and source.is_dir() and image_files:
-        print(
-            f"note: {exact_reason} uses the --exact-shapes directory path "
-            "(bucketed serving is single-chip, host-preprocessed for now, "
-            "docs/SERVING.md)",
-            file=sys.stderr,
-        )
     if image_files:
-        if source.is_dir() and not args.exact_shapes and exact_reason is None:
+        if source.is_dir() and not args.exact_shapes:
             run_images_bucketed(
                 engine, image_files, savedir, args.show_split,
                 args.batch_size, workers=args.workers,
                 buckets=args.serve_buckets, max_wait_ms=args.max_wait_ms,
-                max_buckets=args.max_buckets,
+                max_buckets=args.max_buckets, replicas=args.serve_replicas,
             )
         else:
             run_images_batched(

@@ -1,9 +1,12 @@
 """Worker process for the multi-host training test (see test_multihost.py).
 
-Run as: python tests/multihost_worker.py <process_id> <num_processes> <port> [mode]
-mode: "dp" (default; 4x1 data-parallel mesh) or "dpsp" (2x2 data x spatial
+Run as: python tests/multihost_worker.py <process_id> <num_processes> <port> \
+            [mode] [local_devices]
+mode: "dp" (default; data-parallel mesh) or "dpsp" (2x2 data x spatial
 mesh with the VGG perceptual term ON — the H-gather before the VGG branch
 then crosses the process boundary, the riskiest cross-host collective).
+local_devices: forced CPU devices per process (default 2; the in-suite
+slow dp run uses 1 — see the gloo note below).
 Prints the epoch loss; both ranks must agree (the batch is globally sharded
 and gradients all-reduce across processes).
 """
@@ -16,9 +19,12 @@ proc_id = int(sys.argv[1])
 num_procs = int(sys.argv[2])
 port = sys.argv[3]
 mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
+local_devices = int(sys.argv[5]) if len(sys.argv) > 5 else 2
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={local_devices}"
+)
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from waternet_tpu.utils.platform import ensure_platform  # noqa: E402
@@ -27,6 +33,17 @@ ensure_platform()
 import jax  # noqa: E402
 
 jax.config.update("jax_cpu_collectives_implementation", "gloo")
+# Keep exactly one collective stream per rank: async CPU dispatch (two
+# programs in flight) and >1 local device (two per-device threads inside
+# one execution) can both interleave gloo ops inconsistently across
+# ranks — gloo matches collectives by arrival order per TCP pair, and a
+# mismatch is a hard `op.preamble.length <= op.nbytes` crash (observed:
+# a multi-KB gradient all-reduce on one rank paired with the 4-byte loss
+# psum on the other). Serialized dispatch removes the cross-program
+# race; the in-suite slow dp run additionally uses local_devices=1 so
+# in-program collective order is strictly sequential too. This is a
+# 2-process CPU rehearsal — the lost overlap is noise.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 from waternet_tpu.parallel.distributed import initialize  # noqa: E402
 

@@ -5,9 +5,14 @@ global mesh; the training batch is globally sharded and the gradient
 all-reduce crosses the process boundary. Both ranks must report the same
 loss.
 
-~2-3 min of per-process compilation, so gated behind WATERNET_TEST_MULTIHOST=1
-(the capability is also exercised continuously in single-process form via
-`TrainingEngine._to_global`'s passthrough path).
+~2-3 min of per-process compilation. Two entry points:
+* the full 3-mode parametrized run stays behind WATERNET_TEST_MULTIHOST=1
+  (the historical opt-in);
+* ``test_two_process_training_agrees_slow`` is a ``slow``-marked in-suite
+  entry that sets the 2-process gloo run up itself, so a plain ``-m slow``
+  pass exercises the process boundary without anyone having to remember
+  the env var (the capability is also exercised continuously in
+  single-process form via `TrainingEngine._to_global`'s passthrough path).
 """
 
 import os
@@ -18,10 +23,7 @@ from pathlib import Path
 
 import pytest
 
-pytestmark = pytest.mark.skipif(
-    os.environ.get("WATERNET_TEST_MULTIHOST") != "1",
-    reason="set WATERNET_TEST_MULTIHOST=1 to run the 2-process training test",
-)
+_ENV_OPTED = os.environ.get("WATERNET_TEST_MULTIHOST") == "1"
 
 
 def _free_port():
@@ -32,21 +34,14 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("mode", ["dp", "dpsp", "cached"])
-def test_two_process_training_agrees(mode):
-    """dp: pure data-parallel gradient all-reduce across processes.
-    dpsp: 2x2 (data x spatial) mesh with the perceptual term ON — the VGG
-    branch's H-gather collective crosses the process boundary too.
-    cached: the production --device-cache path (cache_dataset +
-    train_epoch_cached with precached transforms + eval_epoch_cached) —
-    covers _replicate_global's make_array_from_callback branch and the
-    padded remainder batch of _cached_index_batches across processes."""
+def _run_two_process(mode: str, local_devices: int = 2) -> None:
     worker = Path(__file__).parent / "multihost_worker.py"
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     port = str(_free_port())
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), "2", port, mode],
+            [sys.executable, str(worker), str(i), "2", port, mode,
+             str(local_devices)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
         )
         for i in range(2)
@@ -58,10 +53,43 @@ def test_two_process_training_agrees(mode):
             if p.poll() is None:
                 p.kill()
     results = {}
+    expect_devices = str(2 * local_devices)
     for out in outs:
         m = re.search(r"RESULT proc=(\d) procs=(\d) devices=(\d) loss=([\d.]+)", out)
         assert m, f"worker output missing RESULT line:\n{out[-2000:]}"
-        assert m.group(2) == "2" and m.group(3) == "4", out[-500:]
+        assert m.group(2) == "2" and m.group(3) == expect_devices, out[-500:]
         results[m.group(1)] = float(m.group(4))
     assert len(results) == 2
     assert results["0"] == results["1"], results
+
+
+@pytest.mark.skipif(
+    not _ENV_OPTED,
+    reason="set WATERNET_TEST_MULTIHOST=1 to run the full 3-mode "
+    "2-process training matrix",
+)
+@pytest.mark.parametrize("mode", ["dp", "dpsp", "cached"])
+def test_two_process_training_agrees(mode):
+    """dp: pure data-parallel gradient all-reduce across processes.
+    dpsp: 2x2 (data x spatial) mesh with the perceptual term ON — the VGG
+    branch's H-gather collective crosses the process boundary too.
+    cached: the production --device-cache path (cache_dataset +
+    train_epoch_cached with precached transforms + eval_epoch_cached) —
+    covers _replicate_global's make_array_from_callback branch and the
+    padded remainder batch of _cached_index_batches across processes."""
+    _run_two_process(mode)
+
+
+@pytest.mark.slow
+def test_two_process_training_agrees_slow():
+    """In-suite ``-m slow`` entry for the process boundary: the cheapest
+    mode (dp) of the matrix above, with no env-var opt-in to forget —
+    spawning both gloo workers itself. Runs with ONE local device per
+    process (a 2-device global mesh): one collective stream per rank, the
+    configuration this jax build's gloo transport handles reliably (see
+    the worker's gloo note); the cross-process all-reduce — the thing
+    this test pins — is identical. Skips only when the full env-gated
+    matrix is running anyway (same coverage, no double spend)."""
+    if _ENV_OPTED:
+        pytest.skip("WATERNET_TEST_MULTIHOST=1 runs the full 3-mode matrix")
+    _run_two_process("dp", local_devices=1)

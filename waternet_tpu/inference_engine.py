@@ -128,6 +128,17 @@ class InferenceEngine:
             rgb = rgb_u8.astype(jnp.float32) / 255.0
             return _forward(p, rgb, wb / 255.0, he / 255.0, gc / 255.0)
 
+        def _fused_padded(p, canvas_u8, hw):
+            """Bucket-shaped uint8 canvases + native (h, w) -> enhanced
+            float batch, preprocessing on device with native-first
+            statistics (ops/masked.py) — the device-preprocess serving
+            program (docs/SERVING.md)."""
+            from waternet_tpu.ops.masked import transform_masked_batch
+
+            wb, gc, he = transform_masked_batch(canvas_u8, hw[:, 0], hw[:, 1])
+            rgb = canvas_u8.astype(jnp.float32) / 255.0
+            return _forward(p, rgb, wb / 255.0, he / 255.0, gc / 255.0)
+
         self._forward = _forward
         if data_shards > 1:
             # Shard the raw uint8 batch at the boundary so preprocessing
@@ -135,8 +146,12 @@ class InferenceEngine:
             self._fused = jax.jit(
                 _fused, in_shardings=(rep, bsh), out_shardings=bsh
             )
+            self._fused_padded = jax.jit(
+                _fused_padded, in_shardings=(rep, bsh, bsh), out_shardings=bsh
+            )
         else:
             self._fused = jax.jit(_fused)
+            self._fused_padded = jax.jit(_fused_padded)
 
     def _pad_for_shards(self, rgb_batch):
         """-> (padded_batch, n_real). Shards need equal batch slices, so a
@@ -206,7 +221,15 @@ class InferenceEngine:
     # waternet_tpu/serving/ + docs/SERVING.md)
     # ------------------------------------------------------------------
 
-    def preprocess_padded(self, images, bucket_hw, n_slots=None):
+    def replica_params(self, device):
+        """This engine's params placed on ``device`` — one copy per serving
+        replica (waternet_tpu/serving/replicas.py). ``None`` returns the
+        engine's own (default-device) params."""
+        if device is None:
+            return self.params
+        return jax.device_put(self.params, device)
+
+    def preprocess_padded(self, images, bucket_hw, n_slots=None, device=None):
         """Mixed-native-shape uint8 HWC images -> the network's four
         float32 input batches at one ``bucket_hw`` canvas shape.
 
@@ -244,24 +267,91 @@ class InferenceEngine:
                 )
             quads.extend([quads[-1]] * (n_slots - len(quads)))
         x, wb, he, gc = (np.stack(arrs) for arrs in zip(*quads))
-        to_dev = lambda a: jnp.asarray(a, jnp.float32) / 255.0
+        if device is None:
+            to_dev = lambda a: jnp.asarray(a, jnp.float32) / 255.0
+        else:
+            # Per-replica placement: commit the host batch to the replica's
+            # device so the /255 (and the forward it feeds) run there.
+            to_dev = (
+                lambda a: jax.device_put(a.astype(np.float32), device) / 255.0
+            )
         return to_dev(x), to_dev(wb), to_dev(he), to_dev(gc)
 
-    def aot_compile_padded(self, n_slots: int, bucket_hw):
-        """AOT-build the forward executable for one (batch, bucket) shape
-        via ``.lower().compile()`` — no dummy batch materialized, nothing
-        inserted into the jit call cache. The serving warmup compiles one
-        of these per bucket at startup so no request ever pays a compile;
-        dispatch then calls the returned executable directly, which is
-        why a mid-serve growth of ``_forward``'s jit cache is a test
-        failure (tests/test_serving.py, compile_sentinel).
+    def pad_raw_to_bucket(self, images, bucket_hw, n_slots=None):
+        """Mixed-native-shape uint8 HWC images -> (uint8 canvas batch,
+        (N, 2) int32 native shapes) at one ``bucket_hw`` canvas shape —
+        the host side of the *device-preprocess* serving path.
+
+        Only the raw bytes are padded here (reflect, bottom/right); the
+        WB/GC/CLAHE statistics are computed on device over the native
+        region by the fused padded program (ops/masked.py), preserving
+        the native-image-first exactness policy without any host-side
+        transform work. Batch padding repeats the last image, as
+        :meth:`preprocess_padded` does.
         """
+        from waternet_tpu.serving.bucketing import pad_to_bucket
+
+        if not images:
+            raise ValueError(
+                "pad_raw_to_bucket got no images: serving batches are "
+                "non-empty by construction"
+            )
         bh, bw = bucket_hw
-        sds = jax.ShapeDtypeStruct((n_slots, bh, bw, 3), jnp.float32)
-        return self._forward.lower(self.params, sds, sds, sds, sds).compile()
+        canvases = [pad_to_bucket(im, bh, bw) for im in images]
+        hw = [(im.shape[0], im.shape[1]) for im in images]
+        if n_slots is not None:
+            if len(canvases) > n_slots:
+                raise ValueError(
+                    f"{len(canvases)} images exceed the compiled batch of "
+                    f"{n_slots} slots"
+                )
+            canvases.extend([canvases[-1]] * (n_slots - len(canvases)))
+            hw.extend([hw[-1]] * (n_slots - len(hw)))
+        return np.stack(canvases), np.asarray(hw, np.int32)
+
+    def _serving_sds(self, shape, dtype, device):
+        sharding = (
+            None if device is None else jax.sharding.SingleDeviceSharding(device)
+        )
+        if sharding is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    def aot_compile_padded(self, n_slots: int, bucket_hw, device=None, params=None):
+        """AOT-build the serving executable for one (batch, bucket) shape
+        via ``.lower().compile()`` — no dummy batch materialized, nothing
+        inserted into any jit call cache. The serving warmup compiles one
+        of these per (bucket, replica) at startup so no request ever pays
+        a compile; dispatch then calls the returned executable directly,
+        which is why a mid-serve growth of the engine's jit caches is a
+        test failure (tests/test_serving.py, compile_sentinel).
+
+        Host-preprocess engines get the forward-only program (four float
+        input planes); ``device_preprocess`` engines get the fused padded
+        program (uint8 canvases + native shapes -> masked transforms ->
+        forward, ops/masked.py). ``device`` pins the executable (and its
+        lowering-time ``params``, a :meth:`replica_params` product) to one
+        local device — the serving replica pool's placement; sharded
+        engines lower through their own mesh shardings instead and must
+        pass ``device=None``.
+        """
+        if device is not None and (self.data_shards > 1 or self.spatial_shards > 1):
+            raise ValueError(
+                "per-device serving executables are for unsharded engines; "
+                "a sharded engine's executables span its mesh already"
+            )
+        p = self.params if params is None else params
+        bh, bw = bucket_hw
+        if self.device_preprocess:
+            canvas = self._serving_sds((n_slots, bh, bw, 3), jnp.uint8, device)
+            hw = self._serving_sds((n_slots, 2), jnp.int32, device)
+            return self._fused_padded.lower(p, canvas, hw).compile()
+        sds = self._serving_sds((n_slots, bh, bw, 3), jnp.float32, device)
+        return self._forward.lower(p, sds, sds, sds, sds).compile()
 
     def enhance_padded_async(
-        self, images, bucket_hw, n_slots=None, executable=None
+        self, images, bucket_hw, n_slots=None, executable=None, params=None,
+        device=None,
     ):
         """Launch the bucketed forward for ``images`` without blocking.
 
@@ -271,7 +361,19 @@ class InferenceEngine:
         pixels are bit-identical to the native forward). ``executable``
         is an :meth:`aot_compile_padded` product; without one the call
         goes through the jit cache (compiling on first use per shape).
+        ``params``/``device`` place the call on a specific replica
+        (waternet_tpu/serving/replicas.py); by default the engine's own
+        params and the platform default device are used.
         """
-        args = self.preprocess_padded(images, bucket_hw, n_slots)
+        p = self.params if params is None else params
+        if self.device_preprocess:
+            canvas, hw = self.pad_raw_to_bucket(images, bucket_hw, n_slots)
+            if device is None:
+                put = jnp.asarray
+            else:
+                put = lambda a: jax.device_put(a, device)
+            fwd = self._fused_padded if executable is None else executable
+            return fwd(p, put(canvas), put(hw))
+        args = self.preprocess_padded(images, bucket_hw, n_slots, device=device)
         fwd = self._forward if executable is None else executable
-        return fwd(self.params, *args)
+        return fwd(p, *args)

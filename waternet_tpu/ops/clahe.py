@@ -424,7 +424,12 @@ def clahe(
     hist = hist + inc.astype(jnp.int32)
 
     # --- LUTs: rounded scaled CDF ---
-    lut_scale = 255.0 / tile_area
+    # Single-rounded float32 division, exactly OpenCV's
+    # ``const float lutScale = static_cast<float>(histSize - 1) / tileSizeTotal``
+    # (a Python-float 255.0/area would double-round through float64 — and
+    # would not be reproducible by the serving path's dynamic-shape variant,
+    # ops/masked.py, which must divide in f32 on device).
+    lut_scale = np.float32(255.0) / np.float32(tile_area)
     cdf = jnp.cumsum(hist, axis=-1).astype(jnp.float32)
     luts = jnp.clip(jnp.round(cdf * lut_scale), 0.0, 255.0)  # (T, 256)
     luts = luts.reshape(ty, tx, 256)

@@ -1,0 +1,261 @@
+"""Native-first WB/GC/CLAHE on a padded canvas — the device-preprocess
+serving path (docs/SERVING.md "Replica pool").
+
+The bucketed serving engine pads every image up to a compile bucket so one
+executable serves many resolutions. Its exactness policy requires the
+global per-image statistics (WB quantiles, CLAHE tile histograms) to be
+computed on the NATIVE image and the pad applied afterwards — computing
+them on the padded canvas would change every pixel, not just the seam
+band. PR 4 therefore kept ``--device-preprocess`` engines off the
+bucketed path entirely: the stock device transforms
+(:mod:`waternet_tpu.ops.transform`) are shape-specialized to their input,
+so running them at native shape inside a bucket-shaped program was
+impossible.
+
+This module closes that gap: each transform takes the RAW uint8 canvas
+(native image reflect-padded bottom/right, :func:`waternet_tpu.serving.
+bucketing.pad_to_bucket`) plus the native ``(h, w)`` as *dynamic* int32
+scalars, computes its statistics over the native region only, and applies
+the resulting pointwise map to the whole canvas. The exactness argument,
+pinned in tests/test_serving.py:
+
+* **WB** — the per-channel 256-bin histogram is accumulated with invalid
+  pixels routed to a dump bin: integer scatter-adds are order-independent,
+  so the histogram (and its CDF) is bit-identical to the native image's.
+  Channel sums derive from that histogram through the same (3, 256)
+  weighted reduction the native :func:`waternet_tpu.ops.wb.white_balance`
+  uses (refactored for exactly this), so ``sat``/``lo``/``hi`` match
+  bit-for-bit; the clip/stretch/floor that follows is pointwise.
+* **GC** — a 256-entry LUT gather; pointwise, no statistics at all.
+* **CLAHE** — the tile grid is *dynamic*: OpenCV's divisibility padding,
+  tile extents, clip limit, and interpolation grid are all computed from
+  the traced ``(h, w)``. Histograms gather through a mirror index map
+  that reproduces reflect-101 padding values from inside the canvas
+  (so correctness never depends on how much pad the bucket happens to
+  have), tile membership is an integer division by the dynamic tile
+  extent, and the scatter-add accumulation is again exact. The LUT scale
+  and interpolation coordinates use the same single-rounded float32
+  arithmetic as the native path (which mirrors OpenCV's own f32 ops), so
+  native-region output is bit-identical to :func:`waternet_tpu.ops.
+  clahe.clahe` on the native image.
+
+For WB and GC the map is pointwise once its (native) statistics are
+fixed, so their canvas pad regions come out as the transform of the
+reflected content — i.e. exactly the reflect-pad of the transformed
+native image that the host serving path
+(`InferenceEngine.preprocess_padded`) constructs. CLAHE's map is
+position-dependent (the bilinear tile-LUT blend weights follow the
+canvas coordinate), so its pad region holds plausibly-equalized
+reflected content rather than the host path's mirrored values — fine for
+the PSNR-bounded seam band, and irrelevant to interior pixels of the
+network output (beyond the 13 px receptive-field radius from the pad
+seam), whose receptive fields never see pad content and which therefore
+match the native device-preprocess forward (bit-exact up to CLAHE's
+1-ulp blend-contraction caveat; see docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from waternet_tpu.ops.clahe import CLIP_LIMIT, TILE_GRID
+from waternet_tpu.ops.color import lab_u8_to_rgb, rgb_to_lab_u8
+from waternet_tpu.ops.gamma import gamma_correction
+from waternet_tpu.ops.wb import _SAT
+
+
+def _native_mask(shape_hw, h, w):
+    """(H, W) bool: True inside the native top-left (h, w) region."""
+    ch, cw = shape_hw
+    yy = jnp.arange(ch, dtype=jnp.int32)[:, None]
+    xx = jnp.arange(cw, dtype=jnp.int32)[None, :]
+    return (yy < h) & (xx < w)
+
+
+def white_balance_masked(canvas: jnp.ndarray, h, w) -> jnp.ndarray:
+    """Simplest color balance with native-region statistics.
+
+    ``canvas``: (CH, CW, 3) uint8-valued; ``h``/``w``: native extent
+    (traced int32 scalars). Returns float32 exact uint8 values over the
+    whole canvas; the native region is bit-identical to
+    :func:`waternet_tpu.ops.wb.white_balance` on the native image.
+    """
+    x = canvas.astype(jnp.float32)
+    mask = _native_mask(canvas.shape[:2], h, w)
+
+    # Exact per-channel histogram of the native region: invalid pixels go
+    # to a dump slot (integer scatter-add — order-independent, so the
+    # counts equal the native image's bincount bit-for-bit).
+    chan_offset = jnp.arange(3, dtype=jnp.int32) * 256
+    idx = canvas.astype(jnp.int32) + chan_offset
+    idx = jnp.where(mask[..., None], idx, 3 * 256)
+    hist = (
+        jnp.zeros(3 * 256 + 1, jnp.int32)
+        .at[idx.reshape(-1)]
+        .add(1)[: 3 * 256]
+        .reshape(3, 256)
+    )
+    cdf = jnp.cumsum(hist, axis=1)
+    # Same (3, 256) weighted reduction as the native path — bit-identical
+    # sums from bit-identical histograms.
+    sums = (hist.astype(jnp.float32) * jnp.arange(256, dtype=jnp.float32)).sum(
+        axis=1
+    )
+    sat = jnp.clip(_SAT * (sums.max() / jnp.maximum(sums, 1.0)), 0.0, 0.5)
+
+    n = (h * w).astype(jnp.int32)
+
+    def _q(p):
+        pos = p * (n - 1).astype(jnp.float32)
+        i0 = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, n - 1)
+        i1 = jnp.clip(i0 + 1, 0, n - 1)
+        w1 = pos - i0.astype(jnp.float32)
+        a = (cdf < (i0[:, None] + 1)).sum(axis=1).astype(jnp.float32)
+        b = (cdf < (i1[:, None] + 1)).sum(axis=1).astype(jnp.float32)
+        return a * (1.0 - w1) + b * w1
+
+    lo = _q(sat)
+    hi = _q(1.0 - sat)
+    v = jnp.clip(x, lo, hi)
+    out = jnp.where(hi > lo, (v - lo) * 255.0 / jnp.maximum(hi - lo, 1e-9), v)
+    return jnp.floor(out)
+
+
+def clahe_masked(l_canvas: jnp.ndarray, h, w) -> jnp.ndarray:
+    """OpenCV-exact CLAHE (clipLimit=0.1, 8x8 tiles) with a dynamic native
+    extent.
+
+    ``l_canvas``: (CH, CW) uint8-valued L channel whose top-left (h, w)
+    region is the native image (pad content beyond it is ignored — the
+    divisibility pad is re-derived by mirror indexing *into* the native
+    region). Returns float32 exact uint8 values over the whole canvas;
+    the native region is bit-identical to :func:`waternet_tpu.ops.clahe.
+    clahe` on the native L image (gather-path values, which every
+    histogram/interp strategy matches bit-for-bit).
+    """
+    ch, cw = l_canvas.shape
+    ty, tx = TILE_GRID
+    vals = l_canvas.astype(jnp.int32)
+
+    # OpenCV's divisibility pad, dynamic: when EITHER axis is non-divisible
+    # BOTH pad by ``tiles - (size % tiles)`` (a full extra tile-count on an
+    # axis that was already divisible — the clahe.cpp quirk the native path
+    # reproduces).
+    divisible = (h % ty == 0) & (w % tx == 0)
+    pad_h = jnp.where(divisible, 0, ty - h % ty)
+    pad_w = jnp.where(divisible, 0, tx - w % tx)
+    hp = h + pad_h
+    wp = w + pad_w
+    th = hp // ty
+    tw = wp // tx
+    n_tiles = ty * tx
+    tile_area = (th * tw).astype(jnp.int32)
+
+    # --- per-tile histograms over the (dynamically) padded native image ---
+    # The padded rows/cols are reflect-101 of the native content; rather
+    # than trusting the canvas to hold enough reflect pad, gather them
+    # through a mirror index map (y >= h -> 2h-2-y), on a static grid wide
+    # enough for the worst-case pad (a full tile-count per axis).
+    gh, gw = ch + ty, cw + tx
+    ys = jnp.arange(gh, dtype=jnp.int32)
+    xs = jnp.arange(gw, dtype=jnp.int32)
+    sy = jnp.where(ys < h, ys, jnp.clip(2 * h - 2 - ys, 0, jnp.maximum(h - 1, 0)))
+    sx = jnp.where(xs < w, xs, jnp.clip(2 * w - 2 - xs, 0, jnp.maximum(w - 1, 0)))
+    sy = jnp.clip(sy, 0, ch - 1)
+    sx = jnp.clip(sx, 0, cw - 1)
+    grid = vals[sy[:, None], sx[None, :]]  # (gh, gw)
+
+    in_range = (ys[:, None] < hp) & (xs[None, :] < wp)
+    tile_y = jnp.clip(ys[:, None] // jnp.maximum(th, 1), 0, ty - 1)
+    tile_x = jnp.clip(xs[None, :] // jnp.maximum(tw, 1), 0, tx - 1)
+    tile_id = tile_y * tx + tile_x
+    hidx = jnp.where(in_range, tile_id * 256 + grid, n_tiles * 256)
+    hist = (
+        jnp.zeros(n_tiles * 256 + 1, jnp.int32)
+        .at[hidx.reshape(-1)]
+        .add(1)[: n_tiles * 256]
+        .reshape(n_tiles, 256)
+    )
+
+    # --- clip + redistribute (OpenCV integer semantics) ---
+    # clip = max(int(0.1 * area / 256), 1) == max(area // 2560, 1): the f64
+    # literal 0.1 is 0.1*(1+5.6e-17), an upward error far too small to push
+    # int() past an integer boundary for any integer area, so the native
+    # path's trace-time Python formula and this integer division agree for
+    # every tile size.
+    denom = int(round(256.0 / CLIP_LIMIT))
+    clip = jnp.maximum(tile_area // denom, 1)
+    excess = jnp.sum(jnp.maximum(hist - clip, 0), axis=-1)
+    hist = jnp.minimum(hist, clip)
+    hist = hist + (excess // 256)[:, None]
+    residual = excess % 256
+    step = jnp.maximum(256 // jnp.maximum(residual, 1), 1)
+    bins = jnp.arange(256, dtype=jnp.int32)
+    inc = (
+        (residual[:, None] > 0)
+        & (bins[None, :] % step[:, None] == 0)
+        & (bins[None, :] // step[:, None] < residual[:, None])
+    )
+    hist = hist + inc.astype(jnp.int32)
+
+    # --- LUTs: rounded scaled CDF (single-rounded f32 scale, as OpenCV
+    # and the native path) ---
+    lut_scale = jnp.float32(255.0) / tile_area.astype(jnp.float32)
+    cdf2 = jnp.cumsum(hist, axis=-1).astype(jnp.float32)
+    luts = jnp.clip(jnp.round(cdf2 * lut_scale), 0.0, 255.0)
+    luts = luts.reshape(ty, tx, 256)
+
+    # --- bilinear interpolation between tile LUTs (gather formulation,
+    # identical f32 reciprocal/coordinate arithmetic as the native path,
+    # evaluated over the whole canvas) ---
+    inv_th = jnp.float32(1.0) / th.astype(jnp.float32)
+    inv_tw = jnp.float32(1.0) / tw.astype(jnp.float32)
+    yy = jnp.arange(ch, dtype=jnp.float32) * inv_th - jnp.float32(0.5)
+    xx = jnp.arange(cw, dtype=jnp.float32) * inv_tw - jnp.float32(0.5)
+    y1 = jnp.floor(yy).astype(jnp.int32)
+    x1 = jnp.floor(xx).astype(jnp.int32)
+    ya = (yy - y1.astype(jnp.float32))[:, None]
+    xa = (xx - x1.astype(jnp.float32))[None, :]
+    y2 = jnp.minimum(y1 + 1, ty - 1)
+    x2 = jnp.minimum(x1 + 1, tx - 1)
+    y1 = jnp.maximum(y1, 0)
+    x1 = jnp.maximum(x1, 0)
+
+    def look(yi, xi):
+        return luts[yi[:, None], xi[None, :], vals]
+
+    res = (look(y1, x1) * (1.0 - xa) + look(y1, x2) * xa) * (1.0 - ya) + (
+        look(y2, x1) * (1.0 - xa) + look(y2, x2) * xa
+    ) * ya
+    return jnp.clip(jnp.round(res), 0.0, 255.0)
+
+
+def histeq_masked(canvas: jnp.ndarray, h, w) -> jnp.ndarray:
+    """Native-statistics `histeq` on a canvas: RGB -> LAB (pointwise,
+    bit-exact fixed point), :func:`clahe_masked` on L, LAB -> RGB
+    (pointwise float inverse — per-pixel identical to the native path)."""
+    lab = rgb_to_lab_u8(canvas)
+    el = clahe_masked(lab[..., 0], h, w)
+    lab = lab.at[..., 0].set(el)
+    return lab_u8_to_rgb(lab)
+
+
+def transform_masked(canvas: jnp.ndarray, h, w):
+    """One canvas -> (wb, gc, he) float32 canvases, native-first stats.
+
+    Mirrors :func:`waternet_tpu.ops.transform.transform`'s return-order
+    quirk (callers reorder to the network's (x, wb, he, gc))."""
+    return (
+        white_balance_masked(canvas, h, w),
+        gamma_correction(canvas),
+        histeq_masked(canvas, h, w),
+    )
+
+
+transform_masked_batch = jax.vmap(transform_masked, in_axes=(0, 0, 0))
+transform_masked_batch.__doc__ = (
+    "Batched masked transform: (N, CH, CW, 3) canvases + (N,) native h/w "
+    "-> 3x (N, CH, CW, 3) float32."
+)

@@ -77,10 +77,6 @@ def white_balance(rgb: jnp.ndarray) -> jnp.ndarray:
     """
     x = rgb.astype(jnp.float32)
     flat = x.reshape(-1, 3)  # (P, 3)
-    sums = flat.sum(axis=0)
-    # Degenerate-frame guards mirror the host path (all-black channels and
-    # constant channels must not emit NaN into the training batch).
-    sat = jnp.clip(_SAT * (sums.max() / jnp.maximum(sums, 1.0)), 0.0, 0.5)
 
     # Per-channel linear-interpolation quantiles at per-channel
     # probabilities — via 256-bin histogram CDFs, not a sort. Values are
@@ -93,6 +89,18 @@ def white_balance(rgb: jnp.ndarray) -> jnp.ndarray:
     idx = flat.astype(jnp.int32) + chan_offset[None, :]
     hist = jnp.bincount(idx.reshape(-1), length=3 * 256).reshape(3, 256)
     cdf = jnp.cumsum(hist, axis=1)  # (3, 256), cdf[c, v] = #pixels <= v
+
+    # Channel sums from the exact integer histogram rather than a pixel-order
+    # tree reduction: the (3, 256) weighted sum is the SAME computation at
+    # every image size, which is what lets the serving path's masked variant
+    # (ops/masked.py) reproduce these statistics bit-for-bit on a padded
+    # canvas — a (P, 3) reduction's float32 result depends on P.
+    sums = (hist.astype(jnp.float32) * jnp.arange(256, dtype=jnp.float32)).sum(
+        axis=1
+    )
+    # Degenerate-frame guards mirror the host path (all-black channels and
+    # constant channels must not emit NaN into the training batch).
+    sat = jnp.clip(_SAT * (sums.max() / jnp.maximum(sums, 1.0)), 0.0, 0.5)
 
     def _q(p):
         pos = p * (n - 1)

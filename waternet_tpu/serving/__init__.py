@@ -1,12 +1,18 @@
 """Serving layer (L4.5): throughput-oriented inference over arbitrary
-request streams — shape bucketing, dynamic micro-batching, AOT warmup,
-and serving observability. See docs/SERVING.md.
+request streams — shape bucketing, dynamic micro-batching, a multi-device
+replica pool, AOT warmup, and serving observability. See docs/SERVING.md.
 """
 
 from waternet_tpu.serving.batcher import (
     DynamicBatcher,
     ExactShapeBatcher,
+    fit_ladder_to_engine,
     resolve_ladder,
+)
+from waternet_tpu.serving.replicas import (
+    ReplicaPool,
+    engine_jit_cache_size,
+    resolve_replicas,
 )
 from waternet_tpu.serving.bucketing import (
     RECEPTIVE_RADIUS,
@@ -25,12 +31,16 @@ __all__ = [
     "BucketLadder",
     "DynamicBatcher",
     "ExactShapeBatcher",
+    "ReplicaPool",
     "ServingStats",
     "derive_buckets",
+    "engine_jit_cache_size",
+    "fit_ladder_to_engine",
     "pad_to_bucket",
     "padding_overhead",
     "parse_buckets",
     "resolve_ladder",
+    "resolve_replicas",
     "scan_shapes",
     "warmup",
 ]

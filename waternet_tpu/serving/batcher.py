@@ -3,21 +3,27 @@ batcher the inference CLI used before this subsystem existed.
 
 :class:`DynamicBatcher` is the serving engine's core: a request queue
 with ``max_batch`` / ``max_wait_ms`` deadlines that coalesces concurrent
-requests per compile bucket and keeps the device fed through
-``enhance_padded_async`` double-buffering — the dispatcher thread
-host-preprocesses and launches batch N+1 while the completion thread
-syncs batch N's device->host readback, the same H2D / compute / D2H
-overlap discipline as :class:`waternet_tpu.data.pipeline.OrderedPipeline`.
-Results are delivered through per-request futures, so output ordering is
-whatever the caller makes it; consuming futures in submission order
-(:meth:`DynamicBatcher.map_ordered`, the CLI path) is deterministic
-regardless of how requests happened to coalesce into batches, because the
-conv forward is per-sample independent — a request's output never depends
-on its batchmates (pinned in tests/test_serving.py).
+requests per compile bucket and hands each coalesced micro-batch to a
+:class:`waternet_tpu.serving.replicas.ReplicaPool` — one replica per
+serving device (``replicas=1`` by default; the CLI defaults to every
+local device), each with its own launch thread (host preprocess + async
+dispatch) and completion thread (that replica's one D2H sync), so
+preprocessing, device compute, and readback overlap per device AND across
+devices — the H2D / compute / D2H discipline of
+:class:`waternet_tpu.data.pipeline.OrderedPipeline`, multiplied by the
+device count. Results are delivered through per-request futures, so
+output ordering is whatever the caller makes it; consuming futures in
+submission order (:meth:`DynamicBatcher.map_ordered`, the CLI path) is
+deterministic regardless of how requests happened to coalesce into
+batches or which replica served them, because the conv forward is
+per-sample independent and every replica runs the same program on the
+same params — a request's output never depends on its batchmates or its
+replica (pinned in tests/test_serving.py).
 
 Batches are padded up to the compiled ``max_batch`` slot count (last
-image repeated) so every bucket is served by exactly ONE executable —
-that is what bounds the stream's compile count at ``len(buckets)``.
+image repeated) so every bucket is served by exactly ONE executable per
+replica — that is what bounds the stream's compile count at
+``len(buckets) x replicas``, all paid at warmup.
 Occupancy (real requests / slots) is the price, reported per run by
 :class:`waternet_tpu.serving.stats.ServingStats`.
 
@@ -38,20 +44,15 @@ import numpy as np
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.serving.bucketing import BucketLadder
+from waternet_tpu.serving.replicas import (
+    ReplicaPool,
+    engine_jit_cache_size,
+    resolve_replicas,
+)
 from waternet_tpu.serving.stats import ServingStats
-from waternet_tpu.serving.warmup import warmup as _warmup
-from waternet_tpu.utils.tensor import ten2arr
 
 _CLOSE = object()
 _TICK = object()
-
-
-def _forward_cache_size(engine) -> int:
-    """Size of the engine forward's jit executable cache, 0 when this jax
-    build exposes no introspection — the one probe both batchers use to
-    count real compiles (growth across a call = executables built)."""
-    sizer = getattr(engine._forward, "_cache_size", None)
-    return sizer() if callable(sizer) else 0
 
 
 class _Request:
@@ -80,6 +81,11 @@ class DynamicBatcher:
       latency/occupancy dial. The clock starts at dispatcher admission,
       so it bounds coalescing delay specifically — queueing delay under
       overload is capacity-bound and shared by all traffic;
+    * ``replicas`` — serving devices (``'auto'`` = every local device;
+      sharded engines always resolve to 1 — their executable spans the
+      mesh). Each flush goes to the least-loaded replica;
+      ``max_inflight_per_replica`` bounds how far any one device's launch
+      side may run ahead of its D2H sync (2 = double buffering);
     * oversize requests (no covering bucket) fall back to a per-shape
       native forward through the jit cache and are counted in
       ``stats.fallback_native_shapes`` — they pay the compile the ladder
@@ -94,25 +100,32 @@ class DynamicBatcher:
         max_wait_ms: float = 10.0,
         stats: Optional[ServingStats] = None,
         warmup_verbose: bool = False,
+        replicas=1,
+        max_inflight_per_replica: int = 2,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.engine = engine
-        self.ladder = ladder
         self.max_batch = int(max_batch)
+        if engine.data_shards > 1 and self.max_batch % engine.data_shards:
+            # The AOT executable's batch shape is fixed, and a data-sharded
+            # lowering needs equal per-shard slices — round the slot count
+            # up instead of failing warmup with a cryptic pjit error.
+            self.max_batch += engine.data_shards - (
+                self.max_batch % engine.data_shards
+            )
+        self.ladder = ladder = fit_ladder_to_engine(ladder, engine)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.stats = stats if stats is not None else ServingStats()
-        # No request ever pays a compile: the whole executable grid is
-        # built before the first submit is accepted.
-        self._executables = _warmup(
-            engine, ladder, [self.max_batch], stats=self.stats,
-            verbose=warmup_verbose,
+        # No request ever pays a compile: the whole per-replica executable
+        # grid is built before the first submit is accepted.
+        self._pool = ReplicaPool(
+            engine, ladder, [self.max_batch],
+            n_replicas=resolve_replicas(replicas, engine),
+            max_inflight_per_replica=max_inflight_per_replica,
+            stats=self.stats, warmup_verbose=warmup_verbose,
         )
         self._requests: queue.Queue = queue.Queue()
-        # Bounded in-flight window: the dispatcher preprocesses/launches
-        # at most 2 batches ahead of the completion thread's D2H sync —
-        # double buffering, same discipline as the video path.
-        self._inflight: queue.Queue = queue.Queue(maxsize=2)
         self._closed = False
         # Makes the closed-check + enqueue atomic vs close(): without it a
         # racing submit() could land its request BEHIND the _CLOSE
@@ -124,13 +137,11 @@ class DynamicBatcher:
             name=f"{THREAD_PREFIX}-serve-dispatch",
             daemon=True,
         )
-        self._completer = threading.Thread(
-            target=self._complete_loop,
-            name=f"{THREAD_PREFIX}-serve-complete",
-            daemon=True,
-        )
         self._dispatcher.start()
-        self._completer.start()
+
+    @property
+    def n_replicas(self) -> int:
+        return self._pool.n_replicas
 
     # -- public API ----------------------------------------------------
 
@@ -161,15 +172,18 @@ class DynamicBatcher:
         self._requests.put(_TICK)
 
     def close(self) -> None:
-        """Flush pending requests, stop both workers, join them.
-        Idempotent; safe from ``finally``."""
+        """Flush pending requests, stop the dispatcher and every
+        replica's workers, join them all. Idempotent; safe from
+        ``finally``."""
         with self._submit_lock:
             if self._closed:
                 return
             self._closed = True
             self._requests.put(_CLOSE)
-        self._dispatcher.join(timeout=60.0)
-        self._completer.join(timeout=60.0)
+        # The dispatcher's finally closes the pool (draining every
+        # replica's queued work and joining its threads), so one join
+        # covers the whole serving stack.
+        self._dispatcher.join(timeout=120.0)
 
     def __enter__(self) -> "DynamicBatcher":
         return self
@@ -232,7 +246,7 @@ class DynamicBatcher:
                     break
                 self._sweep(pending)  # idle-queue cycles: deadlines fire here
         finally:
-            self._inflight.put(_CLOSE)
+            self._pool.close()
 
     def _admit(self, req: _Request, pending: dict) -> None:
         req.t_admit = time.perf_counter()
@@ -262,63 +276,21 @@ class DynamicBatcher:
         return max(0.0, oldest + self.max_wait_s - time.perf_counter())
 
     def _flush(self, bucket, reqs: List[_Request]) -> None:
+        """Hand one coalesced micro-batch to the least-loaded replica.
+        Host preprocessing, the async device launch, and the D2H sync all
+        happen on that replica's own threads (serving/replicas.py), so
+        this dispatcher only ever routes — a slow readback on one device
+        cannot delay coalescing or launches for the others."""
         if not reqs:
             return
         try:
-            if bucket is None:
-                # Oversize for every bucket: native-shape forwards, one
-                # request each (mixed oversize shapes cannot stack). These
-                # go through the jit cache, so any compile they cause is
-                # real — count it (stats.compiles is "executables built",
-                # warmup AND fallback; the bench line reports it).
-                for r in reqs:
-                    self.stats.record_fallback()
-                    before = _forward_cache_size(self.engine)
-                    out = self.engine.enhance_async(r.image[None])
-                    grew = _forward_cache_size(self.engine) - before
-                    if grew > 0:
-                        self.stats.record_compile(grew)
-                    self._inflight.put((out, [r]))
-                return
-            exe = self._executables[(bucket, self.max_batch)]
-            images = [r.image for r in reqs]
-            out = self.engine.enhance_padded_async(
-                images, bucket, n_slots=self.max_batch, executable=exe
+            self._pool.dispatch(
+                bucket, reqs, queue_depth=self._requests.qsize()
             )
-            bh, bw = bucket
-            self.stats.record_batch(
-                n_real=len(reqs),
-                n_slots=self.max_batch,
-                real_px=sum(im.shape[0] * im.shape[1] for im in images),
-                padded_px=self.max_batch * bh * bw,
-                queue_depth=self._requests.qsize(),
-            )
-            self._inflight.put((out, reqs))
         except BaseException as err:
             for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(err)
-
-    # -- completion ----------------------------------------------------
-
-    def _complete_loop(self) -> None:
-        while True:
-            item = self._inflight.get()
-            if item is _CLOSE:
-                return
-            out_dev, reqs = item
-            try:
-                arr = ten2arr(out_dev)  # the batch's one D2H sync
-            except BaseException as err:
-                for r in reqs:
-                    if not r.future.done():
-                        r.future.set_exception(err)
-                continue
-            t_done = time.perf_counter()
-            for i, r in enumerate(reqs):
-                h, w = r.image.shape[:2]
-                r.future.set_result(arr[i, :h, :w])
-                self.stats.record_latency(t_done - r.t_submit)
 
 
 class ExactShapeBatcher:
@@ -353,9 +325,9 @@ class ExactShapeBatcher:
         if not self._pending:
             return []
         images = [im for _, im, _ in self._pending]
-        before = _forward_cache_size(self.engine)
+        before = engine_jit_cache_size(self.engine)
         outs = self.engine.enhance(np.stack(images))
-        grew = _forward_cache_size(self.engine) - before
+        grew = engine_jit_cache_size(self.engine) - before
         if grew > 0:
             self.stats.record_compile(grew)
         h, w = images[0].shape[:2]
@@ -373,6 +345,28 @@ class ExactShapeBatcher:
             self.stats.record_latency(t_done - t_push)
         self._pending.clear()
         return results
+
+
+def fit_ladder_to_engine(ladder: BucketLadder, engine) -> BucketLadder:
+    """Round a ladder's bucket heights up to what the engine can lower.
+
+    Spatially-sharded engines split H over ``spatial_shards`` devices and
+    need every slab to hold at least ``2 * HALO`` rows, so each bucket
+    height rounds up to the next multiple of the shard count with a
+    ``2 * HALO * shards`` floor — rounding *up* keeps every shape the
+    original ladder covered. Unsharded engines (and batch-sharded
+    ones, whose constraint is on the slot count, not the canvas) pass
+    through untouched.
+    """
+    shards = getattr(engine, "spatial_shards", 1)
+    if shards <= 1:
+        return ladder
+    from waternet_tpu.parallel.spatial import HALO
+
+    min_h = 2 * HALO * shards
+    return BucketLadder(
+        {(max(-(-bh // shards) * shards, min_h), bw) for bh, bw in ladder}
+    )
 
 
 def resolve_ladder(

@@ -1,0 +1,345 @@
+"""Multi-device serving scale-out: a pool of per-device replicas under the
+dynamic batcher (docs/SERVING.md "Replica pool").
+
+WaterNet's serving forward is ~1 MFLOP/pixel with no cross-request state,
+so aggregate images/sec should scale near-linearly with device count once
+nothing serializes between devices — the data-parallel replica-pool shape
+continuous-batching servers use (one request queue multiplexed over N
+model replicas). PR 4's engine drove exactly one device; this pool places
+**params and the AOT-warmed (bucket, max_batch) executable grid on every
+serving device** and gives each replica its own launch and completion
+threads, so
+
+* host preprocessing + H2D + dispatch for replica *i*'s next batch,
+* device compute on replica *j*, and
+* D2H readback on replica *k*
+
+all overlap freely — a blocking ``ten2arr`` on one device never stalls
+dispatch or compute on another (the PR-2 pipeline discipline, per
+device). The batcher's dispatcher routes each coalesced micro-batch to
+the **least-loaded replica** (fewest outstanding batches, ties to the
+lowest index — deterministic), and a bounded ``max_inflight_per_replica``
+keeps every device double-buffered without letting any of them run away
+with the queue.
+
+Outputs are replica-count-invariant by construction: every replica runs
+the same XLA program on the same params, and a request's output never
+depends on its batchmates (the PR-4 exactness policy), so the same
+request stream produces byte-identical results whether it lands on
+replica 0 or 7 — pinned in tests/test_serving.py.
+
+Scope: replicas are for unsharded engines (each replica is one whole
+device). ``data_shards``/``spatial_shards`` engines already span their
+mesh with a single executable and therefore always resolve to ONE
+replica — the mesh *is* the parallelism there. Oversize requests (no
+covering bucket) keep the jit-cache native-shape fallback and are pinned
+to replica 0 so their compile accounting stays race-free.
+
+All worker threads run under the input pipeline's ``THREAD_PREFIX`` so
+the test suite's thread-leak guard covers pool shutdown too.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.serving.bucketing import Bucket, BucketLadder
+from waternet_tpu.serving.stats import ServingStats
+from waternet_tpu.serving.warmup import warmup
+from waternet_tpu.utils.tensor import ten2arr
+
+_CLOSE = object()
+
+
+def engine_jit_cache_size(engine) -> int:
+    """Total executable-cache size of the engine's jit entry points, 0 when
+    this jax build exposes no introspection — the probe the serving layer
+    uses to count *real* compiles (growth across a call = executables
+    built). Sums the forward and both fused programs so device-preprocess
+    fallbacks are counted too."""
+    total = 0
+    for attr in ("_forward", "_fused", "_fused_padded"):
+        sizer = getattr(getattr(engine, attr, None), "_cache_size", None)
+        if callable(sizer):
+            total += sizer()
+    return total
+
+
+def resolve_replicas(spec, engine=None) -> int:
+    """``'auto'`` / ``N`` / ``None`` -> a concrete replica count.
+
+    ``auto`` (and None/empty) means every local device — the tentpole
+    default: a v5e-8 host serves with 8 replicas unless told otherwise.
+    Sharded engines always resolve to 1: their one executable already
+    spans the mesh, and stacking replicas on top would oversubscribe it.
+    """
+    import jax
+
+    sharded = engine is not None and (
+        getattr(engine, "data_shards", 1) > 1
+        or getattr(engine, "spatial_shards", 1) > 1
+    )
+    n_local = max(1, len(jax.local_devices()))
+    # Validate the spec BEFORE the sharded override: a typo'd
+    # --serve-replicas must fail the same way whether or not the engine
+    # happens to be sharded.
+    text = "auto" if spec is None else str(spec).strip().lower()
+    if text in ("", "auto"):
+        return 1 if sharded else n_local
+    try:
+        n = int(text)
+    except ValueError:
+        raise ValueError(
+            f"--serve-replicas must be 'auto' or a positive integer, got "
+            f"{spec!r}"
+        ) from None
+    if n < 1:
+        raise ValueError(f"--serve-replicas must be >= 1, got {n}")
+    if n > n_local:
+        raise ValueError(
+            f"--serve-replicas {n} exceeds the {n_local} local device(s)"
+        )
+    if sharded and n != 1:
+        # An EXPLICIT multi-replica request contradicts a sharded engine
+        # (its one executable already spans the mesh) — refuse loudly
+        # rather than silently serving on one replica; 'auto' resolves to
+        # 1 without complaint.
+        raise ValueError(
+            f"--serve-replicas {n} conflicts with a sharded engine "
+            "(data_shards/spatial_shards engines serve as ONE mesh-"
+            "spanning replica; use --serve-replicas auto or 1)"
+        )
+    return n
+
+
+class _Replica:
+    """One serving device: its params copy, its executable grid, a work
+    queue feeding a launch thread (host preprocess + async dispatch), and
+    a bounded in-flight queue feeding a completion thread (the replica's
+    one D2H sync point)."""
+
+    def __init__(self, pool: "ReplicaPool", index: int, device):
+        self.pool = pool
+        self.index = index
+        self.device = device
+        self.params = pool.engine.replica_params(device)
+        self.executables: Dict[Tuple[Bucket, int], object] = {}
+        self.outstanding = 0  # batches dispatched, not yet completed (pool lock)
+        self.work: queue.Queue = queue.Queue()
+        # Launch at most max_inflight batches ahead of this replica's
+        # completion sync: the device stays double-buffered, and a slow
+        # D2H cannot pile unbounded device allocations behind it.
+        self.inflight: queue.Queue = queue.Queue(maxsize=pool.max_inflight)
+        self._launcher = threading.Thread(
+            target=self._launch_loop,
+            name=f"{THREAD_PREFIX}-serve-launch-{index}",
+            daemon=True,
+        )
+        self._completer = threading.Thread(
+            target=self._complete_loop,
+            name=f"{THREAD_PREFIX}-serve-complete-{index}",
+            daemon=True,
+        )
+
+    def start(self) -> None:
+        self._launcher.start()
+        self._completer.start()
+
+    # -- launch side ---------------------------------------------------
+
+    def _launch_loop(self) -> None:
+        pool = self.pool
+        while True:
+            item = self.work.get()
+            if item is _CLOSE:
+                self.inflight.put(_CLOSE)
+                return
+            bucket, reqs, depth = item
+            try:
+                if bucket is None:
+                    self._launch_fallback(reqs)
+                    continue
+                n_slots = pool.max_batch
+                exe = self.executables[(bucket, n_slots)]
+                images = [r.image for r in reqs]
+                t0 = time.perf_counter()
+                out = pool.engine.enhance_padded_async(
+                    images, bucket, n_slots=n_slots, executable=exe,
+                    params=self.params, device=self.device,
+                )
+                bh, bw = bucket
+                pool.stats.record_batch(
+                    n_real=len(reqs),
+                    n_slots=n_slots,
+                    real_px=sum(im.shape[0] * im.shape[1] for im in images),
+                    padded_px=n_slots * bh * bw,
+                    queue_depth=depth,
+                    replica=self.index,
+                )
+                self.inflight.put((out, reqs, t0))
+            except BaseException as err:
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+                self._done()
+
+    def _launch_fallback(self, reqs) -> None:
+        """Oversize for every bucket: native-shape forwards, one request
+        each (mixed oversize shapes cannot stack). These go through the
+        engine's jit cache on its default device, so any compile they
+        cause is real — count it (stats.compiles is "executables built",
+        warmup AND fallback). Always runs on replica 0, which keeps the
+        cache-size probe single-threaded and race-free."""
+        pool = self.pool
+        for r in reqs:
+            try:
+                pool.stats.record_fallback()
+                before = engine_jit_cache_size(pool.engine)
+                t0 = time.perf_counter()
+                out = pool.engine.enhance_async(r.image[None])
+                grew = engine_jit_cache_size(pool.engine) - before
+                if grew > 0:
+                    pool.stats.record_compile(grew)
+                self.inflight.put((out, [r], t0))
+            except BaseException as err:
+                if not r.future.done():
+                    r.future.set_exception(err)
+                self._done()
+
+    # -- completion side -----------------------------------------------
+
+    def _complete_loop(self) -> None:
+        pool = self.pool
+        while True:
+            item = self.inflight.get()
+            if item is _CLOSE:
+                return
+            out_dev, reqs, t0 = item
+            try:
+                arr = ten2arr(out_dev)  # this replica's one D2H sync
+            except BaseException as err:
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+                self._done()
+                continue
+            t_done = time.perf_counter()
+            for i, r in enumerate(reqs):
+                h, w = r.image.shape[:2]
+                r.future.set_result(arr[i, :h, :w])
+                pool.stats.record_latency(t_done - r.t_submit, replica=self.index)
+            pool.stats.record_replica_busy(self.index, t_done - t0)
+            self._done()
+
+    def _done(self) -> None:
+        with self.pool._lock:
+            self.outstanding -= 1
+
+    def join(self, timeout: float) -> None:
+        self._launcher.join(timeout=timeout)
+        self._completer.join(timeout=timeout)
+
+
+class ReplicaPool:
+    """Place the serving executable grid on ``n_replicas`` local devices
+    and multiplex dispatched micro-batches over them.
+
+    Warmup compiles the full ``len(ladder) x len(batch_sizes) x
+    n_replicas`` executable grid before construction returns, fanning the
+    per-device compiles out over threads (serving/warmup.py) — no request
+    ever pays a compile, on any replica, and the engine's jit caches
+    never grow mid-serve (the PR-4 sentinel guarantee, now
+    ``len(buckets) x replicas`` executables).
+    """
+
+    def __init__(
+        self,
+        engine,
+        ladder: BucketLadder,
+        batch_sizes: Sequence[int],
+        n_replicas: int = 1,
+        max_inflight_per_replica: int = 2,
+        stats: Optional[ServingStats] = None,
+        warmup_verbose: bool = False,
+    ):
+        import jax
+
+        if max_inflight_per_replica < 1:
+            raise ValueError(
+                f"max_inflight_per_replica must be >= 1, got "
+                f"{max_inflight_per_replica}"
+            )
+        sharded = engine.data_shards > 1 or engine.spatial_shards > 1
+        if sharded and n_replicas != 1:
+            raise ValueError(
+                "sharded engines serve as ONE replica spanning their mesh; "
+                f"got n_replicas={n_replicas} with data_shards="
+                f"{engine.data_shards}, spatial_shards={engine.spatial_shards}"
+            )
+        devices = jax.local_devices()
+        if n_replicas > len(devices):
+            raise ValueError(
+                f"n_replicas={n_replicas} exceeds the {len(devices)} local "
+                "device(s)"
+            )
+        self.engine = engine
+        self.max_batch = max(int(b) for b in batch_sizes)
+        self.max_inflight = int(max_inflight_per_replica)
+        self.stats = stats if stats is not None else ServingStats()
+        self.stats.set_replicas(n_replicas)
+        self._lock = threading.Lock()
+        self._closed = False
+        # A single replica keeps the engine's default placement (device
+        # None) — byte-for-byte the PR-4 single-device behavior, and the
+        # only valid form for sharded engines.
+        dev_list = [None] if n_replicas == 1 else list(devices[:n_replicas])
+        self._replicas: List[_Replica] = [
+            _Replica(self, i, dev) for i, dev in enumerate(dev_list)
+        ]
+        grids = warmup(
+            engine, ladder, batch_sizes, stats=self.stats,
+            verbose=warmup_verbose,
+            replicas=[(r.index, r.device, r.params) for r in self._replicas],
+        )
+        for r in self._replicas:
+            r.executables = grids[r.index]
+        for r in self._replicas:
+            r.start()
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    def dispatch(self, bucket: Optional[Bucket], reqs, queue_depth: int = 0) -> None:
+        """Route one coalesced micro-batch (or a fallback group for
+        ``bucket is None``) to the least-loaded replica. Never blocks:
+        work queues are unbounded — the per-replica in-flight bound
+        throttles device memory, not the dispatcher."""
+        if not reqs:
+            return
+        with self._lock:
+            if bucket is None:
+                replica = self._replicas[0]
+            else:
+                replica = min(
+                    self._replicas, key=lambda r: (r.outstanding, r.index)
+                )
+            # Fallback groups launch one forward per request.
+            replica.outstanding += len(reqs) if bucket is None else 1
+        replica.work.put((bucket, reqs, queue_depth))
+
+    def close(self) -> None:
+        """Drain every replica's queued work, stop and join all worker
+        threads. Idempotent; safe from ``finally``."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for r in self._replicas:
+            r.work.put(_CLOSE)
+        for r in self._replicas:
+            r.join(timeout=60.0)

@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import random
 import threading
+import time
 from typing import Dict, List
 
 #: Latency reservoir size: percentiles are computed over at most this many
@@ -42,7 +43,12 @@ class ServingStats:
     * **compiles**: executables built (warmup) + any mid-serve fallback
       compile (a native-shape forward for an oversize request). A
       mid-serve compile for a *bucketed* request is a bug — the
-      compile-sentinel test pins that it never happens.
+      compile-sentinel test pins that it never happens. A replica pool
+      warms ``len(buckets) x replicas`` executables;
+    * **per-replica** occupancy / mean latency / busy seconds, plus the
+      aggregate **images_per_sec** (requests completed over the
+      first-dispatch -> last-completion span) and **load_imbalance**
+      (max over mean per-replica request count; 1.0 = perfectly even).
     """
 
     def __init__(self):
@@ -59,10 +65,33 @@ class ServingStats:
         self.fallback_native = 0
         self._depth_sum = 0
         self.depth_max = 0
+        self.replicas = 1
+        self._rep = {}  # index -> per-replica accumulator dict
+        self._t_first_batch = None
+        self._t_last_done = None
 
-    def record_latency(self, seconds: float) -> None:
+    def set_replicas(self, n: int) -> None:
+        """Declare the serving replica count (idle replicas must show up
+        as imbalance, so every index gets an accumulator up front)."""
+        with self._lock:
+            self.replicas = int(n)
+            for i in range(self.replicas):
+                self._rep.setdefault(i, self._new_rep())
+
+    @staticmethod
+    def _new_rep() -> dict:
+        return {
+            "requests": 0, "batches": 0, "real_slots": 0, "total_slots": 0,
+            "lat_sum_s": 0.0, "busy_s": 0.0,
+        }
+
+    def record_latency(self, seconds: float, replica: int = 0) -> None:
         with self._lock:
             self.requests += 1
+            rep = self._rep.setdefault(replica, self._new_rep())
+            rep["requests"] += 1
+            rep["lat_sum_s"] += seconds
+            self._t_last_done = time.perf_counter()
             if len(self._latencies_s) < LATENCY_RESERVOIR:
                 self._latencies_s.append(seconds)
             else:
@@ -75,7 +104,7 @@ class ServingStats:
 
     def record_batch(
         self, n_real: int, n_slots: int, real_px: int, padded_px: int,
-        queue_depth: int = 0,
+        queue_depth: int = 0, replica: int = 0,
     ) -> None:
         with self._lock:
             self.batches += 1
@@ -85,6 +114,19 @@ class ServingStats:
             self.padded_px += padded_px
             self._depth_sum += queue_depth
             self.depth_max = max(self.depth_max, queue_depth)
+            rep = self._rep.setdefault(replica, self._new_rep())
+            rep["batches"] += 1
+            rep["real_slots"] += n_real
+            rep["total_slots"] += n_slots
+            if self._t_first_batch is None:
+                self._t_first_batch = time.perf_counter()
+
+    def record_replica_busy(self, replica: int, seconds: float) -> None:
+        """Launch->completion wall time of one batch on one replica —
+        the device-occupancy proxy the pool reports per replica."""
+        with self._lock:
+            rep = self._rep.setdefault(replica, self._new_rep())
+            rep["busy_s"] += seconds
 
     def record_compile(self, n: int = 1) -> None:
         with self._lock:
@@ -93,6 +135,11 @@ class ServingStats:
     def record_fallback(self) -> None:
         with self._lock:
             self.fallback_native += 1
+            # A fallback is a dispatch too: the throughput span must start
+            # at the first dispatch of ANY kind, or an all-oversize stream
+            # reports images_per_sec = 0.0 despite completing work.
+            if self._t_first_batch is None:
+                self._t_first_batch = time.perf_counter()
 
     def occupancy(self) -> float:
         with self._lock:
@@ -111,6 +158,61 @@ class ServingStats:
             "p99": round(_percentile(vals, 0.99) * 1e3, 3),
         }
 
+    def images_per_sec(self) -> float:
+        """Aggregate completed-requests throughput over the first-dispatch
+        -> last-completion span (0.0 before any batch completes)."""
+        with self._lock:
+            if (
+                self._t_first_batch is None
+                or self._t_last_done is None
+                or self._t_last_done <= self._t_first_batch
+            ):
+                return 0.0
+            return self.requests / (self._t_last_done - self._t_first_batch)
+
+    def load_imbalance(self) -> float:
+        """max / mean per-replica request count over every configured
+        replica (idle replicas count as 0, so they show up). 1.0 is a
+        perfectly even pool; 1.0 by definition when nothing was served."""
+        with self._lock:
+            counts = [
+                self._rep.get(i, {}).get("requests", 0)
+                for i in range(self.replicas)
+            ]
+        total = sum(counts)
+        if total == 0 or not counts:
+            return 1.0
+        return max(counts) / (total / len(counts))
+
+    def per_replica(self) -> List[dict]:
+        """Per-replica occupancy/latency rollup, by replica index."""
+        with self._lock:
+            reps = {i: dict(r) for i, r in self._rep.items()}
+            for i in range(self.replicas):
+                reps.setdefault(i, self._new_rep())
+        out = []
+        for i in sorted(reps):
+            r = reps[i]
+            out.append(
+                {
+                    "replica": i,
+                    "requests": r["requests"],
+                    "batches": r["batches"],
+                    "occupancy": round(
+                        r["real_slots"] / r["total_slots"], 4
+                    )
+                    if r["total_slots"]
+                    else 0.0,
+                    "latency_ms_mean": round(
+                        r["lat_sum_s"] / r["requests"] * 1e3, 3
+                    )
+                    if r["requests"]
+                    else 0.0,
+                    "busy_sec": round(r["busy_s"], 3),
+                }
+            )
+        return out
+
     def summary(self) -> dict:
         """The JSON stats block (docs/SERVING.md schema)."""
         with self._lock:
@@ -120,6 +222,7 @@ class ServingStats:
             requests = self.requests
             compiles = self.compiles
             fallback = self.fallback_native
+            replicas = self.replicas
         return {
             "requests": requests,
             "batches": batches,
@@ -130,6 +233,10 @@ class ServingStats:
             "fallback_native_shapes": fallback,
             "queue_depth_mean": round(depth_mean, 2),
             "queue_depth_max": depth_max,
+            "replicas": replicas,
+            "images_per_sec": round(self.images_per_sec(), 2),
+            "load_imbalance": round(self.load_imbalance(), 3),
+            "per_replica": self.per_replica(),
         }
 
     def to_json(self) -> str:

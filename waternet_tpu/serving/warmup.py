@@ -1,24 +1,36 @@
-"""AOT warmup: compile every (bucket, batch) executable before serving.
+"""AOT warmup: compile every (bucket, batch[, replica]) executable before
+serving.
 
 A mid-serve XLA compile is a multi-second stall on the request path — the
 exact pathology bucketing exists to remove — so the batcher refuses to
 rely on jit's compile-on-first-call. At startup this module
 ``.lower().compile()``s one executable per (bucket, batch-slot) shape via
-:meth:`InferenceEngine.aot_compile_padded`; dispatch then calls those
-executables directly and the engine's jit cache is never consulted for a
-bucketed request. That makes the no-recompile guarantee *testable*: the
-PR-3 ``compile_sentinel`` fixture arms ``engine._forward`` after warmup
-and any growth during serving fails the test
+:meth:`InferenceEngine.aot_compile_padded` — and, when a replica pool is
+serving, one per **replica device** (``replicas=[(index, device, params),
+...]``), fanning the per-device compiles out over a thread pool so an
+N-replica server's warmup approaches the cost of one device's, not N
+times it (XLA compilation releases the GIL). Dispatch then calls those
+executables directly and the engine's jit caches are never consulted for
+a bucketed request. That makes the no-recompile guarantee *testable*: the
+PR-3 ``compile_sentinel`` fixture arms the engine's jits after warmup and
+any growth during serving fails the test
 (tests/test_serving.py::test_bucketed_stream_compiles_len_buckets_executables).
 """
 
 from __future__ import annotations
 
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Sequence, Tuple
 
+from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.serving.bucketing import Bucket, BucketLadder
 from waternet_tpu.serving.stats import ServingStats
+
+#: Upper bound on concurrent warmup compile threads: enough to cover a
+#: full pod-slice host (8 replicas) without turning a many-bucket ladder
+#: into a thread stampede.
+MAX_WARMUP_THREADS = 8
 
 
 def warmup(
@@ -27,27 +39,68 @@ def warmup(
     batch_sizes: Sequence[int],
     stats: Optional[ServingStats] = None,
     verbose: bool = False,
-) -> Dict[Tuple[Bucket, int], object]:
-    """Compile the full (bucket x batch-size) executable grid.
+    replicas=None,
+):
+    """Compile the full (bucket x batch-size[, replica]) executable grid.
 
-    Returns ``{((bh, bw), n): executable}``; every compile is counted in
-    ``stats`` (the bench contract's ``compiles`` field). With the
+    Without ``replicas`` (the pre-pool form, kept for direct callers):
+    returns ``{((bh, bw), n): executable}`` compiled for the engine's
+    default placement. With ``replicas`` — a list of ``(index, device,
+    params)`` triples from the pool — returns ``{index: {((bh, bw), n):
+    executable}}`` with each grid pinned to its replica's device, the
+    compiles running in parallel threads.
+
+    Every compile is counted in ``stats`` (the bench contract's
+    ``compiles`` field): an N-replica pool builds exactly
+    ``len(ladder) * len(batch_sizes) * N`` executables. With the
     persistent XLA compile cache enabled (utils/platform.py) repeated
     server startups deserialize instead of recompiling, but each shape
     still counts as one executable here — the number the acceptance
     criterion bounds is executables built, not cache misses.
     """
-    executables: Dict[Tuple[Bucket, int], object] = {}
-    for bucket in ladder:
-        for n in sorted(set(int(b) for b in batch_sizes)):
-            t0 = time.perf_counter()
-            executables[(bucket, n)] = engine.aot_compile_padded(n, bucket)
-            if stats is not None:
-                stats.record_compile()
-            if verbose:
-                bh, bw = bucket
-                print(
-                    f"serving warmup: compiled {n}x{bh}x{bw} in "
-                    f"{time.perf_counter() - t0:.1f}s"
-                )
-    return executables
+    sizes = sorted(set(int(b) for b in batch_sizes))
+    if replicas is None:
+        jobs = [(None, None, None, bucket, n) for bucket in ladder for n in sizes]
+    else:
+        jobs = [
+            (index, device, params, bucket, n)
+            for (index, device, params) in replicas
+            for bucket in ladder
+            for n in sizes
+        ]
+
+    def compile_one(job):
+        index, device, params, bucket, n = job
+        t0 = time.perf_counter()
+        exe = engine.aot_compile_padded(n, bucket, device=device, params=params)
+        if stats is not None:
+            stats.record_compile()
+        if verbose:
+            bh, bw = bucket
+            where = "" if index is None else f" on replica {index}"
+            print(
+                f"serving warmup: compiled {n}x{bh}x{bw}{where} in "
+                f"{time.perf_counter() - t0:.1f}s"
+            )
+        return index, bucket, n, exe
+
+    if len(jobs) == 1 or replicas is None:
+        results = [compile_one(j) for j in jobs]
+    else:
+        # Deliberate compile fan-out: this is server startup, the one
+        # place compiles belong; everything after dispatches prebuilt
+        # executables.
+        with ThreadPoolExecutor(
+            max_workers=min(MAX_WARMUP_THREADS, len(jobs)),
+            thread_name_prefix=f"{THREAD_PREFIX}-serve-warmup",
+        ) as pool:
+            results = list(pool.map(compile_one, jobs))
+
+    if replicas is None:
+        return {(bucket, n): exe for _, bucket, n, exe in results}
+    grids: Dict[int, Dict[Tuple[Bucket, int], object]] = {
+        index: {} for (index, _, _) in replicas
+    }
+    for index, bucket, n, exe in results:
+        grids[index][(bucket, n)] = exe
+    return grids
