@@ -53,6 +53,14 @@ devices share the host's physical cores, so CPU speedups track the core
 count, not the device count; the near-linear regime is real multi-chip
 hardware.
 
+``--config serve_http`` measures the HTTP front door end-to-end
+(serving/server.py + the closed-loop load generator, docs/SERVING.md
+"Front door"): the ``http_images_per_sec`` line reports sustained
+throughput over real sockets, the unloaded and loaded p99, and the
+429 shed rate at 2x the sustainable offered load against a tight
+admission watermark — with total request accounting (``accounted``)
+pinning that nothing is silently dropped.
+
 The last stdout line is the contract JSON:
 {"metric", "value", "unit", "vs_baseline"}. When no hardware is reachable
 the process exits rc 0 with ``value: 0.0`` and an ``error`` field — "no
@@ -439,6 +447,116 @@ def bench_serving_multi(
         "device_kind": getattr(
             jax.local_devices()[0], "device_kind", "unknown"
         ),
+    }
+
+
+def bench_serving_http(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+    concurrency=None, requests_per_phase=None,
+):
+    """End-to-end HTTP front-door throughput (serving/server.py,
+    docs/SERVING.md "Front door"): a real server on an ephemeral port,
+    driven by the closed-loop load generator over actual sockets —
+    request decode, admission control, batching, device compute, PNG
+    encode, and response delivery all inside the measurement.
+
+    Three phases on the same server: a serial pass for the unloaded p99,
+    a closed-loop pass at ``concurrency`` workers (the
+    ``http_images_per_sec`` contract value), and a 2x-concurrency
+    overload pass against a deliberately tight admission watermark —
+    ``shed_rate_at_2x`` is the fraction of offered load the server
+    refused with 429 instead of queueing (the bounded-backpressure
+    acceptance criterion). The accounting is total: ``accounted`` pins
+    that every request of the overload phase ended in ok / shed /
+    deadline / rejected / transport-error — nothing silently dropped.
+    """
+    import cv2
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving import derive_buckets
+    from waternet_tpu.serving.loadgen import run_load
+    from waternet_tpu.serving.server import ServingServer
+
+    n_images, max_batch, max_buckets = _serving_env_defaults(
+        n_images, max_batch, max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+    concurrency = (
+        _env_int("WATERNET_BENCH_SERVE_CONCURRENCY", 2 * max_batch)
+        if concurrency is None else concurrency
+    )
+    n_req = (
+        _env_int("WATERNET_BENCH_SERVE_REQUESTS", 2 * n_images)
+        if requests_per_phase is None else requests_per_phase
+    )
+
+    params = _serving_params()
+    images, shapes = _serving_population(n_images, base)
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+    payloads = [cv2.imencode(".png", im[:, :, ::-1])[1].tobytes() for im in images]
+
+    server = ServingServer(
+        InferenceEngine(params=params), ladder,
+        max_batch=max_batch, max_wait_ms=5.0, replicas=1,
+        # Tight bound so the 2x phase actually sheds: the queue holds at
+        # most ~2 batches of undispatched work before 429s start.
+        max_queue=4 * max_batch, admit_watermark=2 * max_batch,
+    )
+    t0 = time.perf_counter()
+    server.start_background()
+    server.wait_ready()
+    warmup_s = time.perf_counter() - t0
+    try:
+        unloaded = run_load(
+            server.url, payloads, concurrency=1, total=min(n_req, 16)
+        )
+        loaded = run_load(
+            server.url, payloads, concurrency=concurrency, total=n_req
+        )
+        overload = run_load(
+            server.url, payloads, concurrency=2 * concurrency, total=n_req
+        )
+    finally:
+        server.request_drain()
+        server.join()
+    summary = server.stats.summary()
+
+    # Total accounting, cross-checked AGAINST THE SERVER (the client-side
+    # counters alone sum to `sent` by construction): every 200 a client
+    # saw is a request the server computed, every 429 a shed it counted —
+    # a black-holed request would skew one side and read accounted=false.
+    phases = (unloaded, loaded, overload)
+    accounted = (
+        summary["requests"] == sum(p["ok"] for p in phases)
+        and summary["shed_count"] == sum(p["shed"] for p in phases)
+        and summary["deadline_expired"]
+        == sum(p["deadline_expired"] for p in phases)
+        and all(p["errors"] == 0 for p in phases)
+    )
+    return {
+        "metric": "http_images_per_sec",
+        "value": loaded["images_per_sec"],
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "p99_ms": loaded["latency_ms"]["p99"],
+        "p99_unloaded_ms": unloaded["latency_ms"]["p99"],
+        "shed_rate_at_2x": round(
+            overload["shed"] / overload["sent"], 4
+        ) if overload["sent"] else 0.0,
+        "images_per_sec_at_2x": overload["images_per_sec"],
+        "p99_ms_at_2x": overload["latency_ms"]["p99"],
+        "accounted": bool(accounted),
+        "shed_count": summary["shed_count"],
+        "deadline_expired": summary["deadline_expired"],
+        "queue_depth_max": summary["queue_depth_max"],
+        "batch_occupancy": summary["batch_occupancy"],
+        "compiles": summary["compiles"],
+        "buckets": ladder.describe(),
+        "warmup_sec": round(warmup_s, 1),
+        "concurrency": concurrency,
+        "requests_per_phase": n_req,
+        "n_images": n_images,
+        "max_batch": max_batch,
     }
 
 
@@ -956,13 +1074,16 @@ def main():
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
-        "--config", choices=["train", "video", "serve", "serve_multi"],
+        "--config",
+        choices=["train", "video", "serve", "serve_multi", "serve_http"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
         "(mixed-resolution directory inference: bucketed vs "
-        "--exact-shapes A/B, docs/SERVING.md), or serve_multi "
-        "(replica-pool scale-out: N replicas vs 1 on the same stream)",
+        "--exact-shapes A/B, docs/SERVING.md), serve_multi "
+        "(replica-pool scale-out: N replicas vs 1 on the same stream), "
+        "or serve_http (the HTTP front door end-to-end over real "
+        "sockets: throughput, p99, and shed rate at 2x offered load)",
     )
     parser.add_argument(
         "--batch-size", type=int, default=4,
@@ -977,6 +1098,7 @@ def main():
     fail_metric = {
         "serve": "mixed_res_dir_images_per_sec",
         "serve_multi": "mixed_res_dir_images_per_sec_multidev",
+        "serve_http": "http_images_per_sec",
     }.get(args.config, "uieb_train_images_per_sec_per_chip")
 
     def _fail(error: str, rc: int = 0):
@@ -1059,6 +1181,10 @@ def main():
 
     if args.config == "serve_multi":
         print(json.dumps(bench_serving_multi()))
+        return
+
+    if args.config == "serve_http":
+        print(json.dumps(bench_serving_http()))
         return
 
     # Two lines (see module docstring): the strict apples-to-apples host-fed

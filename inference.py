@@ -33,7 +33,11 @@ TPU-native differences:
   parity, see waternet_tpu.ops), which is the fast path when host CPU is
   scarce — including on the bucketed directory path, where each replica
   computes the transforms on-device with native-image-first statistics
-  (waternet_tpu/ops/masked.py).
+  (waternet_tpu/ops/masked.py);
+* ``--serve-url http://host:port`` turns the CLI into a thin client of a
+  running ``waternet-serve`` front door (docs/SERVING.md "Front door"):
+  image sources POST to the server and outputs land in the same layout,
+  byte-for-byte — no local weights or accelerator needed.
 """
 
 from __future__ import annotations
@@ -174,6 +178,16 @@ def parse_args(argv=None):
         "mesh-spanning replica) or an explicit N. Each replica holds its "
         "own params copy and AOT-warmed executables; outputs are "
         "byte-identical at any replica count (docs/SERVING.md).",
+    )
+    parser.add_argument(
+        "--serve-url",
+        type=str,
+        default=None,
+        help="(Optional) Act as a thin client against a running "
+        "waternet-serve front door (docs/SERVING.md) instead of loading "
+        "weights locally: image sources POST to <url>/enhance and "
+        "outputs land in the same layout as local serving, byte-for-"
+        "byte. Honors the server's 429 backpressure (bounded retries).",
     )
     return parser.parse_args(argv)
 
@@ -369,6 +383,65 @@ def run_images_bucketed(
     return batcher.stats
 
 
+def run_images_remote(
+    url: str, paths, savedir: Path, show_split: bool, max_retries: int = 10,
+):
+    """Thin client for the HTTP front door (docs/SERVING.md "Front
+    door"): POST each image file's bytes to ``<url>/enhance`` and write
+    the responses in the same layout as local serving.
+
+    The server decodes the bytes exactly as the local path decodes the
+    file (``cv2.imdecode`` == ``cv2.imread``) and runs the same bucketed
+    replica-pool pipeline, and PNG transport is lossless — so the output
+    files are byte-for-byte what a local run with the server's
+    configuration writes (pinned in tests/test_server.py): the CLI and
+    the service are behaviorally interchangeable. A 429 (admission
+    control shedding) is retried after the server's ``Retry-After``, up
+    to ``max_retries`` times; any other non-200 aborts loudly.
+    """
+    import http.client
+    import time as _time
+    from urllib.parse import urlparse
+
+    import cv2
+
+    u = urlparse(url)
+    conn = http.client.HTTPConnection(
+        u.hostname, u.port or 80, timeout=300
+    )
+    try:
+        for path in paths:
+            bgr = cv2.imread(str(path))
+            if bgr is None:
+                print(f"Skipping unreadable image: {path}", file=sys.stderr)
+                continue
+            data = path.read_bytes()
+            for attempt in range(max_retries + 1):
+                conn.request(
+                    "POST", "/enhance", body=data,
+                    headers={"Content-Type": "application/octet-stream"},
+                )
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 429:
+                    break
+                retry_after = float(resp.getheader("Retry-After", "1"))
+                _time.sleep(min(retry_after, 5.0))
+            if resp.status != 200:
+                raise SystemExit(
+                    f"server returned {resp.status} for {path.name}: "
+                    f"{body[:200]!r}"
+                )
+            out_bgr = cv2.imdecode(
+                np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR
+            )
+            out = make_split(bgr, out_bgr) if show_split else out_bgr
+            savedir.mkdir(parents=True, exist_ok=True)
+            cv2.imwrite(str(savedir / path.name), out)
+    finally:
+        conn.close()
+
+
 def run_video(
     engine, path: Path, savedir: Path, show_split: bool, batch_size: int,
     workers: int = 2,
@@ -414,6 +487,33 @@ def run_video(
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.serve_url:
+        # Thin-client mode: no weights, no engine, no jax — the running
+        # front door owns the model. Same source handling and output
+        # layout as local serving (behavioral interchangeability,
+        # docs/SERVING.md).
+        from waternet_tpu.utils.rundir import next_run_dir
+
+        source = Path(args.source)
+        assert source.exists(), f"{args.source} does not exist!"
+        files = (
+            sorted(
+                p for p in source.glob("*")
+                if p.suffix.lower() in VID_SUFFIXES + IM_SUFFIXES
+            )
+            if source.is_dir() else [source]
+        )
+        if any(f.suffix.lower() in VID_SUFFIXES for f in files):
+            raise SystemExit(
+                "--serve-url serves image sources only (the front door is "
+                "a request/response gateway; stream videos locally or "
+                "frame-split them first)"
+            )
+        print(f"Total images/videos: {len(files)}")
+        savedir = next_run_dir(Path(__file__).parent / "output", args.name)
+        run_images_remote(args.serve_url, files, savedir, args.show_split)
+        print(f"Saved output to {savedir}!")
+        return
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()

@@ -1,0 +1,728 @@
+"""HTTP serving front door (waternet_tpu/serving/server.py): ephemeral-
+port smoke (healthz -> enhance -> stats), admission control + bounded
+backpressure under overload, per-request deadline semantics, graceful
+SIGTERM drain (subprocess, exit 0, byte-identical in-flight responses),
+hot weight reload (invariance + mismatch rollback), the serving-side
+fault kinds, the --serve-url thin client, the compile-sentinel guarantee
+across the server path incl. a reload, and the bench serve_http
+contract line. See docs/SERVING.md "Front door".
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from waternet_tpu.serving import (
+    BucketLadder,
+    DeadlineExpired,
+    DynamicBatcher,
+    QueueFull,
+)
+from waternet_tpu.serving.loadgen import run_load
+from waternet_tpu.serving.server import ServingServer
+from waternet_tpu.utils.tensor import ten2arr
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: One bucket / one slot count everywhere in this module, so every server
+#: (in-process fixtures AND the drain subprocess) warms the same
+#: executable shape — after the first compile the persistent XLA cache
+#: makes each later warmup a deserialize, keeping the module tier-1-fast.
+BUCKET = (32, 32)
+MAX_BATCH = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    from waternet_tpu.models import WaterNet
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    return InferenceEngine(params=params)
+
+
+@pytest.fixture
+def server(engine):
+    """A running front door: one bucket, generous queue. Function-scoped
+    on purpose: the conftest thread-leak guard then proves full server
+    shutdown (HTTP thread, dispatcher, replica workers) after every
+    single test — a leaked serving thread is a drain bug. Warmups after
+    the first are persistent-compile-cache deserializes."""
+    srv = ServingServer(
+        engine,
+        BucketLadder([BUCKET]),
+        max_batch=MAX_BATCH,
+        max_wait_ms=30,
+        replicas=1,
+        max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    yield srv
+    srv.request_drain()
+    assert srv.join() == 0
+
+
+def _request(port, method, path, body=None, headers=None, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, dict(resp.getheaders()), data
+    finally:
+        conn.close()
+
+
+def _png(img_bgr_or_rgb):
+    import cv2
+
+    ok, buf = cv2.imencode(".png", img_bgr_or_rgb)
+    assert ok
+    return buf.tobytes()
+
+
+def _expected_offline(engine, rgb):
+    """The offline enhance_padded output the server must match byte-for-
+    byte: same bucket, same slot count, same crop as the batcher."""
+    h, w = rgb.shape[:2]
+    out = ten2arr(
+        engine.enhance_padded_async([rgb], BUCKET, n_slots=MAX_BATCH)
+    )
+    return out[0, :h, :w]
+
+
+def _response_rgb(body):
+    import cv2
+
+    bgr = cv2.imdecode(np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR)
+    assert bgr is not None
+    return cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+
+
+# ---------------------------------------------------------------------------
+# Smoke: healthz -> enhance -> stats on an ephemeral port (tier-1-fast)
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_enhance_stats_smoke(server, engine, rng):
+    port = server.bound_port
+    status, _, body = _request(port, "GET", "/healthz")
+    assert status == 200
+    health = json.loads(body)
+    assert health == {"ready": True, "warmed": True, "draining": False}
+
+    bgr = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    status, headers, body = _request(port, "POST", "/enhance", body=_png(bgr))
+    assert status == 200
+    assert headers.get("Content-Type") == "image/png"
+    # Byte-identical to the offline enhance_padded output: the gateway
+    # adds transport, not math (PNG both ways is lossless).
+    np.testing.assert_array_equal(
+        _response_rgb(body), _expected_offline(engine, bgr[:, :, ::-1])
+    )
+
+    status, _, body = _request(port, "GET", "/stats")
+    assert status == 200
+    stats = json.loads(body)
+    assert stats["requests"] >= 1
+    assert {"shed_count", "deadline_expired", "queue_depth"} <= set(stats)
+    assert stats["queue_depth"] == 0  # nothing outstanding between tests
+
+    status, _, _ = _request(port, "GET", "/no-such-route")
+    assert status == 404
+    status, _, body = _request(port, "POST", "/enhance", body=b"not an image")
+    assert status == 400
+    assert b"not a decodable image" in body
+    status, _, _ = _request(port, "GET", "/enhance")
+    assert status == 405
+
+
+def test_hostile_headers_do_not_kill_the_handler(server, rng):
+    """Remote-triggerable parse hazards answer or close cleanly instead
+    of killing the connection handler: a malformed Content-Length
+    degrades to an empty body (400, not an unhandled ValueError), and a
+    header line past asyncio's 64 KiB stream limit (readline raises
+    ValueError, not LimitOverrunError) closes the connection — the
+    server keeps serving either way."""
+    import socket
+
+    port = server.bound_port
+    for bad_cl in (b"abc", b"-1"):  # -1 would make readexactly raise
+        with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+            s.sendall(
+                b"POST /enhance HTTP/1.1\r\nContent-Length: "
+                + bad_cl + b"\r\n\r\n"
+            )
+            assert s.recv(4096).startswith(b"HTTP/1.1 400 ")
+    # Valid JSON that is not an object: 400, not an unhandled TypeError.
+    status, _, _ = _request(port, "POST", "/admin/reload", body=b"[1]")
+    assert status == 400
+    with socket.create_connection(("127.0.0.1", port), timeout=30) as s:
+        s.sendall(b"GET /healthz HTTP/1.1\r\nX-Junk: " + b"A" * (1 << 17))
+        s.sendall(b"\r\n\r\n")
+        # Oversized line: the server closes (FIN, or RST when our unread
+        # bytes are still in its socket buffer) — either way, no crash.
+        try:
+            assert s.recv(4096) == b""
+        except ConnectionResetError:
+            pass
+    assert _request(port, "GET", "/healthz")[0] == 200  # still serving
+
+
+def test_deadline_semantics_over_http(server, engine, rng):
+    """Per-request deadlines: an unmeetable budget is rejected up front
+    (504, never queued); a tiny budget expires at dispatch and is dropped
+    with a counter, not computed; a generous budget serves normally and
+    clamps nothing observable."""
+    port = server.bound_port
+    before = json.loads(_request(port, "GET", "/stats")[2])
+
+    bgr = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    payload = _png(bgr)
+
+    status, _, _ = _request(
+        port, "POST", "/enhance", body=payload,
+        headers={"X-Deadline-Ms": "-5"},
+    )
+    assert status == 504  # up-front: negative budget cannot be met
+    status, _, _ = _request(
+        port, "POST", "/enhance", body=payload,
+        headers={"X-Deadline-Ms": "bogus"},
+    )
+    assert status == 400
+    # A 3 ms budget against a 30 ms coalescing window: the deadline
+    # clamps the wait (the sweep fires at ~3 ms, not 30), finds the
+    # request expired, and drops it un-computed -> 504 + counter.
+    status, _, _ = _request(
+        port, "POST", "/enhance", body=payload,
+        headers={"X-Deadline-Ms": "3"},
+    )
+    assert status == 504
+    status, _, body = _request(
+        port, "POST", "/enhance", body=payload,
+        headers={"X-Deadline-Ms": "60000"},
+    )
+    assert status == 200
+    np.testing.assert_array_equal(
+        _response_rgb(body), _expected_offline(engine, bgr[:, :, ::-1])
+    )
+
+    after = json.loads(_request(port, "GET", "/stats")[2])
+    assert after["deadline_expired"] - before["deadline_expired"] == 2
+    # The dropped request was never computed: only the served one counts.
+    assert after["requests"] - before["requests"] == 1
+
+
+def test_min_deadline_floor_rejects_up_front(engine, rng):
+    """Operators can pin a known serving floor: budgets below it are
+    refused before they enter the queue."""
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, max_queue=16, min_deadline_ms=50.0,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        bgr = np.asarray(rng.integers(0, 256, (20, 20, 3)), dtype=np.uint8)
+        status, _, body = _request(
+            srv.bound_port, "POST", "/enhance", body=_png(bgr),
+            headers={"X-Deadline-Ms": "10"},
+        )
+        assert status == 504
+        assert b"cannot be met" in body
+        assert srv.stats.summary()["requests"] == 0  # never admitted
+        assert srv.stats.summary()["deadline_expired"] == 1
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+# ---------------------------------------------------------------------------
+# Admission control: bounded backpressure under overload
+# ---------------------------------------------------------------------------
+
+
+def test_overload_sheds_429_bounded_and_fully_accounted(engine, rng):
+    """The overload acceptance pin: past the watermark the server sheds
+    with 429 + Retry-After instead of queueing; every request ends in
+    exactly one bucket (ok / shed / deadline / rejected / error) — no
+    silent drops; every ADMITTED request completes (client 200s ==
+    server-side completions); and after the storm nothing is left
+    outstanding (bounded queue, bounded memory)."""
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=2, max_wait_ms=5,
+        max_queue=8, admit_watermark=2,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        imgs = [
+            np.asarray(rng.integers(0, 256, (28 + i, 30, 3)), dtype=np.uint8)
+            for i in range(4)
+        ]
+        rep = run_load(
+            srv.url, [_png(im) for im in imgs], concurrency=8, total=48
+        )
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+    # Snapshot AFTER the drain: the completion thread records request
+    # counts after resolving futures, so a snapshot racing the last
+    # client 200 could read one short. Drain joins those threads.
+    summary = srv.stats.summary()
+
+    assert rep["errors"] == 0
+    assert rep["shed"] > 0, rep  # 8 closed-loop workers vs watermark 2
+    assert rep["ok"] > 0, rep
+    assert (
+        rep["ok"] + rep["shed"] + rep["deadline_expired"] + rep["rejected"]
+        == rep["sent"]
+    )
+    # Client-observed 200s == server-side completions: nothing admitted
+    # was silently dropped.
+    assert summary["requests"] == rep["ok"]
+    assert summary["shed_count"] == rep["shed"]
+    assert summary["queue_depth"] == 0  # drained: nothing outstanding
+
+
+def test_reject_admit_fault_sheds_deterministically(server, rng):
+    """The reject_admit@K serving fault: the K-th admission is force-
+    shed with 429 regardless of load — the shed path is testable without
+    saturating anything."""
+    from waternet_tpu.resilience import faults
+
+    port = server.bound_port
+    bgr = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    payload = _png(bgr)
+    faults.install(faults.FaultPlan.parse("reject_admit@2"))
+    try:
+        s1, _, _ = _request(port, "POST", "/enhance", body=payload)
+        s2, h2, _ = _request(port, "POST", "/enhance", body=payload)
+        s3, _, _ = _request(port, "POST", "/enhance", body=payload)
+    finally:
+        faults.clear()
+    assert (s1, s2, s3) == (200, 429, 200)
+    assert h2.get("Retry-After") == "1"
+
+
+def test_slow_replica_fault_delays_once():
+    """The slow_replica@K hook fires exactly once at the K-th launch."""
+    from waternet_tpu.resilience import faults
+
+    faults.install(faults.FaultPlan.parse("slow_replica@2"))
+    try:
+        os.environ["WATERNET_FAULT_SLOW_SEC"] = "0.125"
+        assert faults.replica_launch_delay() == 0.0  # launch 1
+        assert faults.replica_launch_delay() == 0.125  # launch 2: armed
+        assert faults.replica_launch_delay() == 0.0  # one-shot
+    finally:
+        os.environ.pop("WATERNET_FAULT_SLOW_SEC", None)
+        faults.clear()
+    assert faults.replica_launch_delay() == 0.0  # no plan: no-op
+
+
+# ---------------------------------------------------------------------------
+# Library-level admission control (satellite: the unbounded-queue fix)
+# ---------------------------------------------------------------------------
+
+
+def test_dynamic_batcher_max_queue_raises_queuefull(engine, rng):
+    """max_queue bounds OUTSTANDING requests: with a long coalescing
+    window, the third submit against max_queue=2 is refused with a clear
+    QueueFull (and counted as shed) — no unbounded growth. Draining
+    resolves the admitted two and reopens admission."""
+    img = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    b = DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=10_000, max_queue=2,
+    )
+    try:
+        f1, f2 = b.submit(img), b.submit(img)
+        with pytest.raises(QueueFull, match="max_queue=2"):
+            b.submit(img)
+        assert b.queue_depth() == 2
+        assert b.stats.summary()["shed_count"] == 1
+        assert b.stats.summary()["queue_depth"] == 2  # the live gauge
+        b.drain()
+        assert f1.result(timeout=60).shape == img.shape
+        assert f2.result(timeout=60).shape == img.shape
+        assert b.queue_depth() == 0
+        b.submit(img)  # below the bound again: admitted
+        b.drain()
+    finally:
+        b.close()
+    with pytest.raises(ValueError, match="max_queue"):
+        DynamicBatcher(engine, BucketLadder([BUCKET]), max_queue=0)
+
+
+def test_dynamic_batcher_deadline_clamps_wait_and_drops_expired(engine, rng):
+    """Library-level deadline semantics: an already-past deadline is
+    rejected at submit; a 20 ms deadline against a 10 s coalescing
+    window flushes at ~20 ms (clamped wait), finds the lone request
+    expired, and drops it with a counter — un-computed."""
+    img = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    with DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=10_000,
+    ) as b:
+        with pytest.raises(DeadlineExpired):
+            b.submit(img, deadline=time.perf_counter() - 0.01)
+        t0 = time.perf_counter()
+        fut = b.submit(img, deadline=time.perf_counter() + 0.02)
+        with pytest.raises(DeadlineExpired):
+            fut.result(timeout=30)
+        waited = time.perf_counter() - t0
+        assert waited < 5.0, "deadline did not clamp the 10 s window"
+        s = b.stats.summary()
+        assert s["deadline_expired"] == 2
+        assert s["requests"] == 0  # dropped requests are never computed
+        # A deadline with room to spare serves normally.
+        fut = b.submit(img, deadline=time.perf_counter() + 60.0)
+        b.drain()
+        assert fut.result(timeout=60).shape == img.shape
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain (acceptance: SIGTERM under traffic -> exit 0)
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_drain_completes_inflight_byte_identical(
+    engine, params, tmp_path, rng
+):
+    """The drain acceptance pin, against a real process: SIGTERM with
+    admitted requests still in flight -> late arrivals get 503 +
+    Connection: close, every admitted request completes byte-identical
+    to the offline enhance_padded output, stats are flushed, and the
+    process exits 0 within the grace window."""
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    weights = tmp_path / "w.npz"
+    save_weights(params, weights)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONUNBUFFERED="1",
+        # Stall the first (only) batch launch so the drain window is
+        # deterministically open while work is in flight.
+        WATERNET_FAULTS="slow_replica@1",
+        WATERNET_FAULT_SLOW_SEC="1.5",
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "waternet_tpu.serving.server",
+            "--weights", str(weights), "--port", "0",
+            "--serve-buckets", "32", "--max-batch", str(MAX_BATCH),
+            "--max-wait-ms", "5000", "--grace-sec", "30",
+        ],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    lines = []
+    pump = threading.Thread(
+        target=lambda: lines.extend(ln.rstrip() for ln in proc.stdout),
+        daemon=True,
+    )
+    pump.start()
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while port is None and time.monotonic() < deadline:
+            for ln in list(lines):
+                if "listening on" in ln:
+                    port = int(ln.rsplit(":", 1)[1])
+            time.sleep(0.05)
+        assert port, f"no listening line in {lines}"
+        while time.monotonic() < deadline:
+            try:
+                if _request(port, "GET", "/healthz", timeout=5)[0] == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+
+        imgs = [
+            np.asarray(
+                rng.integers(0, 256, (28 + i, 30, 3)), dtype=np.uint8
+            )
+            for i in range(3)
+        ]
+        results = {}
+
+        def post(i):
+            results[i] = _request(
+                port, "POST", "/enhance", body=_png(imgs[i]), timeout=60
+            )
+
+        posters = [
+            threading.Thread(target=post, args=(i,)) for i in range(3)
+        ]
+        for t in posters:
+            t.start()
+        # All three admitted (outstanding) before the preemption lands.
+        while time.monotonic() < deadline:
+            s = json.loads(_request(port, "GET", "/stats", timeout=5)[2])
+            if s["queue_depth"] == 3:
+                break
+            time.sleep(0.02)
+        assert s["queue_depth"] == 3
+
+        proc.send_signal(signal.SIGTERM)
+        while time.monotonic() < deadline:  # drain latched?
+            h = json.loads(_request(port, "GET", "/healthz", timeout=5)[2])
+            if h["draining"]:
+                break
+            time.sleep(0.02)
+        # Late arrival during the drain: refused, connection closed.
+        status, headers, _ = _request(
+            port, "POST", "/enhance", body=_png(imgs[0]), timeout=30
+        )
+        assert status == 503
+        assert headers.get("Connection") == "close"
+
+        for t in posters:
+            t.join(60)
+        assert proc.wait(timeout=30) == 0  # clean exit inside the grace
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    pump.join(10)
+
+    # Every admitted request completed, byte-identical to offline.
+    for i, img in enumerate(imgs):
+        status, _, body = results[i]
+        assert status == 200, f"in-flight request {i} got {status}"
+        np.testing.assert_array_equal(
+            _response_rgb(body), _expected_offline(engine, img[:, :, ::-1])
+        )
+    # Stats flushed on the way out, with the drain's shed visible.
+    stats_lines = [
+        ln for ln in lines if ln.startswith('{"serving_stats"')
+    ]
+    assert len(stats_lines) == 1
+    flushed = json.loads(stats_lines[0])["serving_stats"]
+    assert flushed["requests"] == 3
+    assert flushed["queue_depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Hot weight reload
+# ---------------------------------------------------------------------------
+
+
+def test_hot_reload_invariance_and_mismatch_rollback(
+    server, engine, params, tmp_path, rng
+):
+    """Reloading identical weights is byte-unobservable in outputs; a
+    mismatched checkpoint is refused with the named diff and rolls back
+    (the server keeps serving the old weights)."""
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    port = server.bound_port
+    bgr = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    payload = _png(bgr)
+    before = _request(port, "POST", "/enhance", body=payload)
+    assert before[0] == 200
+
+    same = tmp_path / "same.npz"
+    save_weights(params, same)
+    status, _, body = _request(
+        port, "POST", "/admin/reload",
+        body=json.dumps({"weights": str(same)}).encode(),
+    )
+    assert status == 200 and json.loads(body)["reloaded"] is True
+    after = _request(port, "POST", "/enhance", body=payload)
+    assert after[0] == 200
+    assert after[2] == before[2], "identical-weights reload changed bytes"
+
+    # Mismatched shapes: refused, named, rolled back.
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    leaves = [np.asarray(l) for l in leaves]
+    leaves[0] = np.zeros(leaves[0].shape + (2,), np.float32)
+    bad = jax.tree_util.tree_unflatten(treedef, leaves)
+    badpath = tmp_path / "bad.npz"
+    save_weights(bad, badpath)
+    status, _, body = _request(
+        port, "POST", "/admin/reload",
+        body=json.dumps({"weights": str(badpath)}).encode(),
+    )
+    assert status == 409
+    err = json.loads(body)
+    assert err["reloaded"] is False
+    assert "mismatch" in err["error"]
+    still = _request(port, "POST", "/enhance", body=payload)
+    assert still[0] == 200 and still[2] == before[2], "rollback failed"
+
+    # Unreadable path: a 400, not a crash — and still serving.
+    status, _, _ = _request(
+        port, "POST", "/admin/reload",
+        body=json.dumps({"weights": str(tmp_path / "missing.npz")}).encode(),
+    )
+    assert status == 400
+    assert _request(port, "GET", "/healthz")[0] == 200
+
+
+def test_no_jit_growth_across_serve_and_reload(
+    params, tmp_path, rng, compile_sentinel
+):
+    """The compile-sentinel guarantee holds across the SERVER path too,
+    including a hot reload: all executables are built at warmup
+    (len(buckets) x replicas), and neither serving nor reloading grows
+    any jit cache — a reload swaps params, never programs."""
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    eng = InferenceEngine(params=params)
+    srv = ServingServer(
+        eng, BucketLadder([BUCKET]), max_batch=MAX_BATCH, max_wait_ms=5,
+        max_queue=16,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    compile_sentinel.arm(forward=eng._forward)
+    try:
+        port = srv.bound_port
+        payload = _png(
+            np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        )
+        assert _request(port, "POST", "/enhance", body=payload)[0] == 200
+        weights = tmp_path / "w.npz"
+        save_weights(params, weights)
+        status, _, _ = _request(
+            port, "POST", "/admin/reload",
+            body=json.dumps({"weights": str(weights)}).encode(),
+        )
+        assert status == 200
+        assert _request(port, "POST", "/enhance", body=payload)[0] == 200
+        summary = srv.stats.summary()
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+    compile_sentinel.check()  # zero jit-cache growth, reload included
+    assert summary["compiles"] == 1  # the warmup grid, nothing else
+    assert summary["fallback_native_shapes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# --serve-url thin client: CLI and service interchangeable
+# ---------------------------------------------------------------------------
+
+
+def test_cli_serve_url_matches_local_serving(
+    server, params, tmp_path, monkeypatch, rng
+):
+    """inference.py --serve-url writes the same files, byte-for-byte, as
+    local bucketed serving with the server's configuration — the CLI and
+    the service are behaviorally interchangeable."""
+    cv2 = pytest.importorskip("cv2")
+
+    import inference as cli
+
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    weights = tmp_path / "w.npz"
+    save_weights(params, weights)
+    src = tmp_path / "imgs"
+    src.mkdir()
+    for i, (h, w) in enumerate([(30, 30), (28, 32), (32, 32)]):
+        im = np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+        cv2.imwrite(str(src / f"im{i}.png"), im)
+
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir",
+        lambda base, name=None: tmp_path / "local",
+    )
+    cli.main(
+        ["--source", str(src), "--weights", str(weights),
+         "--batch-size", str(MAX_BATCH), "--serve-buckets", "32",
+         "--serve-replicas", "1"]
+    )
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir",
+        lambda base, name=None: tmp_path / "remote",
+    )
+    cli.main(["--source", str(src), "--serve-url", server.url])
+
+    for p in sorted(src.glob("*.png")):
+        local = (tmp_path / "local" / p.name).read_bytes()
+        remote = (tmp_path / "remote" / p.name).read_bytes()
+        assert local == remote, f"{p.name}: thin client drifted from local"
+
+    (src / "clip.mp4").write_bytes(b"\x00")  # suffix is what routes it
+    with pytest.raises(SystemExit, match="image sources only"):
+        cli.main(["--source", str(src), "--serve-url", server.url])
+
+
+# ---------------------------------------------------------------------------
+# Bench contract: serve_http
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serve_http_contract_line():
+    """The http_images_per_sec line: schema, total accounting, and the
+    shed machinery visible at 2x offered load against the tight bench
+    watermark (CPU smoke sizes)."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    line = bench.bench_serving_http(
+        n_images=6, max_batch=2, max_buckets=1, base_hw=24,
+        concurrency=4, requests_per_phase=12,
+    )
+    assert line["metric"] == "http_images_per_sec"
+    assert line["unit"] == "images/sec"
+    assert line["value"] > 0
+    assert line["accounted"] is True
+    assert line["p99_ms"] > 0 and line["p99_unloaded_ms"] > 0
+    assert 0.0 <= line["shed_rate_at_2x"] <= 1.0
+    assert line["compiles"] == 1
+    assert line["queue_depth_max"] >= 0
+    assert line["warmup_sec"] >= 0
+    assert {"shed_count", "deadline_expired"} <= set(line)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 4,
+    reason="the 3x p99 bound is a queueing bound; on a 1-core host the "
+    "closed-loop client threads contend with server compute for the "
+    "same core, inflating the overload p99 with CPU-scheduling noise "
+    "the criterion (real accelerator hardware) does not have",
+)
+def test_overload_p99_within_3x_unloaded():
+    """The overload latency acceptance criterion at a more realistic
+    size: with admission control shedding, the p99 of ADMITTED requests
+    at 2x offered load stays within 3x the unloaded p99 (the queue a
+    request can be behind is bounded by the watermark)."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    line = bench.bench_serving_http(
+        n_images=12, max_batch=2, max_buckets=1, base_hw=48,
+        concurrency=4, requests_per_phase=48,
+    )
+    assert line["shed_rate_at_2x"] > 0, line
+    assert line["p99_ms_at_2x"] <= 3 * line["p99_unloaded_ms"], line

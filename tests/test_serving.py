@@ -370,6 +370,10 @@ def test_stats_schema_and_latency_percentiles():
         s.record_latency(ms / 1e3, replica=0)
     s.record_compile(2)
     s.record_replica_busy(0, 0.5)
+    s.record_shed()
+    s.record_shed()
+    s.record_deadline_expired()
+    s.queue_depth_probe = lambda: 7  # what a live DynamicBatcher registers
     lat = s.latency_ms()
     assert lat["p50"] == pytest.approx(2.0)
     assert lat["p99"] == pytest.approx(100.0)
@@ -379,9 +383,17 @@ def test_stats_schema_and_latency_percentiles():
     assert set(summary) == {
         "requests", "batches", "latency_ms", "batch_occupancy",
         "padding_overhead", "compiles", "fallback_native_shapes",
+        "shed_count", "deadline_expired", "queue_depth",
         "queue_depth_mean", "queue_depth_max", "replicas",
         "images_per_sec", "load_imbalance", "per_replica",
     }
+    # The admission-control fields (front door, docs/SERVING.md): shed and
+    # deadline counters accumulate; queue_depth is LIVE via the probe and
+    # 0 for stats nothing registered on (ExactShapeBatcher, bare tests).
+    assert summary["shed_count"] == 2
+    assert summary["deadline_expired"] == 1
+    assert summary["queue_depth"] == 7
+    assert ServingStats().summary()["queue_depth"] == 0
     # One replica served everything, the other idled: maximal imbalance
     # for 2 replicas, and the idle one still appears in the rollup.
     assert summary["replicas"] == 2
@@ -892,7 +904,8 @@ def test_bench_serving_multi_scales_on_multicore():
 @pytest.mark.parametrize(
     "config,metric",
     [("serve", "mixed_res_dir_images_per_sec"),
-     ("serve_multi", "mixed_res_dir_images_per_sec_multidev")],
+     ("serve_multi", "mixed_res_dir_images_per_sec_multidev"),
+     ("serve_http", "http_images_per_sec")],
 )
 def test_bench_serve_fail_line_keeps_own_metric(config, metric):
     """Unreachable hardware in the serve configs: rc 0 and the

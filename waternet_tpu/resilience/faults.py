@@ -20,6 +20,17 @@ is a set of one-shot events, each keyed by a deterministic counter:
   when enough consecutive attempts are armed to exhaust the retries, the
   quarantine path — exactly where production hits them: inside the input
   pipeline's workers.
+* ``slow_replica@K`` — the K-th bucketed batch *launch* (1-based,
+  process-global across every replica's launch thread, under a lock)
+  sleeps ``WATERNET_FAULT_SLOW_SEC`` (default 0.25) before dispatching,
+  simulating a replica whose device stalls mid-serve — the deterministic
+  way to hold work in flight so drain, deadline-expiry, and shed paths
+  are testable (serving/replicas.py calls :func:`replica_launch_delay`).
+* ``reject_admit@K`` — the K-th admission attempt at the HTTP front door
+  (1-based, process-global) is force-shed with 429 regardless of queue
+  depth, exercising the shed path and client retry behavior without
+  having to actually saturate the queue
+  (serving/server.py calls :func:`admit_should_reject`).
 
 Plans come from the environment (``WATERNET_FAULTS="nan@3,sigterm@10"``,
 read once by :func:`install_from_env`, which train.py calls) or from tests
@@ -43,12 +54,18 @@ from pathlib import Path
 _PLAN: "FaultPlan | None" = None
 _IMREAD_CALLS = 0
 _IMREAD_LOCK = threading.Lock()
+_LAUNCH_CALLS = 0
+_ADMIT_CALLS = 0
+_SERVE_LOCK = threading.Lock()
 
 
 class FaultPlan:
     """One-shot fault events keyed by (kind, ordinal)."""
 
-    KINDS = ("nan", "sigterm", "truncate_ckpt", "decode")
+    KINDS = (
+        "nan", "sigterm", "truncate_ckpt", "decode",
+        "slow_replica", "reject_admit",
+    )
 
     def __init__(self, events=()):
         self._pending = set()
@@ -86,10 +103,13 @@ class FaultPlan:
 
 
 def install(plan: FaultPlan | None) -> None:
-    global _PLAN, _IMREAD_CALLS
+    global _PLAN, _IMREAD_CALLS, _LAUNCH_CALLS, _ADMIT_CALLS
     _PLAN = plan
     with _IMREAD_LOCK:
         _IMREAD_CALLS = 0
+    with _SERVE_LOCK:
+        _LAUNCH_CALLS = 0
+        _ADMIT_CALLS = 0
 
 
 def clear() -> None:
@@ -154,6 +174,41 @@ def imread_should_fail() -> bool:
     with _IMREAD_LOCK:
         _IMREAD_CALLS += 1
         return _PLAN.fire("decode", _IMREAD_CALLS)
+
+
+def replica_launch_delay() -> float:
+    """Hook run before each bucketed batch launch in
+    :meth:`waternet_tpu.serving.replicas._Replica._launch_loop`.
+
+    Returns the seconds this launch should stall (kind ``slow_replica``,
+    keyed by a process-global launch counter across every replica's
+    launch thread; delay from ``WATERNET_FAULT_SLOW_SEC``, default 0.25)
+    or 0.0. With no plan installed this is a single ``is None`` check.
+    """
+    global _LAUNCH_CALLS
+    if _PLAN is None:
+        return 0.0
+    with _SERVE_LOCK:
+        _LAUNCH_CALLS += 1
+        if _PLAN.fire("slow_replica", _LAUNCH_CALLS):
+            return float(os.environ.get("WATERNET_FAULT_SLOW_SEC", "0.25"))
+    return 0.0
+
+
+def admit_should_reject() -> bool:
+    """Hook run at each HTTP front-door admission attempt
+    (waternet_tpu/serving/server.py).
+
+    Returns True when this admission should be force-shed with 429 (kind
+    ``reject_admit``, keyed by a process-global admission counter). With
+    no plan installed this is a single ``is None`` check.
+    """
+    global _ADMIT_CALLS
+    if _PLAN is None:
+        return False
+    with _SERVE_LOCK:
+        _ADMIT_CALLS += 1
+        return _PLAN.fire("reject_admit", _ADMIT_CALLS)
 
 
 def after_checkpoint_save(path, ordinal: int) -> None:

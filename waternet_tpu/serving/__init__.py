@@ -1,11 +1,16 @@
 """Serving layer (L4.5): throughput-oriented inference over arbitrary
 request streams — shape bucketing, dynamic micro-batching, a multi-device
-replica pool, AOT warmup, and serving observability. See docs/SERVING.md.
+replica pool, AOT warmup, serving observability, and the HTTP front door
+(``waternet_tpu.serving.server`` — imported explicitly, not re-exported,
+so library users of the batcher never touch the gateway stack). See
+docs/SERVING.md.
 """
 
 from waternet_tpu.serving.batcher import (
+    DeadlineExpired,
     DynamicBatcher,
     ExactShapeBatcher,
+    QueueFull,
     fit_ladder_to_engine,
     resolve_ladder,
 )
@@ -29,8 +34,10 @@ from waternet_tpu.serving.warmup import warmup
 __all__ = [
     "RECEPTIVE_RADIUS",
     "BucketLadder",
+    "DeadlineExpired",
     "DynamicBatcher",
     "ExactShapeBatcher",
+    "QueueFull",
     "ReplicaPool",
     "ServingStats",
     "derive_buckets",

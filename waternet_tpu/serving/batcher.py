@@ -55,19 +55,38 @@ _CLOSE = object()
 _TICK = object()
 
 
-class _Request:
-    __slots__ = ("image", "future", "t_submit", "t_admit")
+class QueueFull(RuntimeError):
+    """submit() refused: the batcher's bounded request queue is at
+    ``max_queue``. Under overload, admission — not memory — is the thing
+    that gives; callers shed (the HTTP front door answers 429) or retry
+    later instead of queueing without bound."""
 
-    def __init__(self, image: np.ndarray):
+
+class DeadlineExpired(RuntimeError):
+    """A request's deadline ran out before its batch was computed. Raised
+    from submit() when the deadline is already past at admission, and set
+    on the request's future when the deadline expires while the request
+    waits for dispatch — the batch is launched without it (dropped with
+    ``stats.deadline_expired``, not computed)."""
+
+
+class _Request:
+    __slots__ = ("image", "future", "t_submit", "t_admit", "deadline")
+
+    def __init__(self, image: np.ndarray, deadline: Optional[float] = None):
         self.image = image
         self.future: Future = Future()
         # t_submit anchors the reported request latency; t_admit (set when
         # the dispatcher moves the request into its bucket's pending list)
         # anchors the max_wait deadline — the knob bounds time spent
         # WAITING FOR BATCHMATES, not queueing delay, which under overload
-        # is capacity-bound and shared by all traffic.
+        # is capacity-bound and shared by all traffic. ``deadline`` is an
+        # absolute perf_counter instant (None = no deadline): it CLAMPS
+        # the coalescing wait (a lone request never waits out a window it
+        # cannot afford) and, once past, drops the request at dispatch.
         self.t_submit = time.perf_counter()
         self.t_admit = self.t_submit
+        self.deadline = deadline
 
 
 class DynamicBatcher:
@@ -89,7 +108,15 @@ class DynamicBatcher:
     * oversize requests (no covering bucket) fall back to a per-shape
       native forward through the jit cache and are counted in
       ``stats.fallback_native_shapes`` — they pay the compile the ladder
-      could not absorb.
+      could not absorb;
+    * ``max_queue`` — bound on OUTSTANDING requests (submitted and not
+      yet resolved: queued, coalescing, or in flight on a replica). At
+      the bound, submit() raises :class:`QueueFull` instead of queueing
+      forever: every outstanding request holds host RAM until its future
+      resolves, so this is the knob that keeps RSS and queueing delay
+      bounded under overload. The default is generous (the CLI's own
+      windowing never comes near it); servers set it to their real
+      watermark (docs/SERVING.md "Front door").
     """
 
     def __init__(
@@ -102,9 +129,12 @@ class DynamicBatcher:
         warmup_verbose: bool = False,
         replicas=1,
         max_inflight_per_replica: int = 2,
+        max_queue: int = 8192,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.engine = engine
         self.max_batch = int(max_batch)
         if engine.data_shards > 1 and self.max_batch % engine.data_shards:
@@ -127,6 +157,19 @@ class DynamicBatcher:
         )
         self._requests: queue.Queue = queue.Queue()
         self._closed = False
+        self.max_queue = int(max_queue)
+        # Outstanding-request count: submitted and not yet RESOLVED —
+        # queued, coalescing, or in flight on a replica. This is the
+        # admission-control gauge and the QueueFull bound: the
+        # dispatcher itself only routes (it hands coalesced batches to
+        # per-replica work queues in microseconds), so a bound on the
+        # undispatched slice alone would never trip under overload —
+        # what grows without limit is work admitted faster than devices
+        # finish it, and every such request holds host RAM until its
+        # future resolves. Decremented by a future done-callback, which
+        # covers every resolution path (result, error, deadline drop).
+        self._backlog = 0
+        self.stats.queue_depth_probe = self.queue_depth
         # Makes the closed-check + enqueue atomic vs close(): without it a
         # racing submit() could land its request BEHIND the _CLOSE
         # sentinel, where the dispatcher never looks — the caller would
@@ -145,19 +188,67 @@ class DynamicBatcher:
 
     # -- public API ----------------------------------------------------
 
-    def submit(self, image: np.ndarray) -> Future:
+    def submit(
+        self, image: np.ndarray, deadline: Optional[float] = None
+    ) -> Future:
         """Queue one (H, W, 3) uint8 image; resolves to its enhanced
-        native-shape uint8 array. Thread-safe."""
+        native-shape uint8 array. Thread-safe.
+
+        ``deadline`` is an absolute ``time.perf_counter()`` instant.
+        Already past at admission -> :class:`DeadlineExpired` here (the
+        up-front rejection); still pending when it expires -> the future
+        gets :class:`DeadlineExpired` and the batch launches without the
+        request. Either way ``stats.deadline_expired`` counts it. Raises
+        :class:`QueueFull` at the ``max_queue`` bound — admission control
+        instead of unbounded queueing.
+        """
         if image.ndim != 3 or image.shape[-1] != 3:
             raise ValueError(
                 f"expected one (H, W, 3) image, got shape {image.shape}"
             )
-        req = _Request(image)
+        if deadline is not None and deadline <= time.perf_counter():
+            self.stats.record_deadline_expired()
+            raise DeadlineExpired(
+                "deadline already past at admission (the coalescing window "
+                "plus compute cannot finish in negative time)"
+            )
+        req = _Request(image, deadline=deadline)
+        req.future.add_done_callback(self._on_request_resolved)
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("DynamicBatcher is closed")
+            if self._backlog >= self.max_queue:
+                self.stats.record_shed()
+                raise QueueFull(
+                    f"{self._backlog} requests outstanding, max_queue="
+                    f"{self.max_queue}: shedding instead of queueing forever"
+                )
+            self._backlog += 1
             self._requests.put(req)
         return req.future
+
+    def _on_request_resolved(self, _future) -> None:
+        """Done-callback on every request future: runs on whichever
+        thread resolves it (replica completion, error path, deadline
+        drop), so the outstanding count can never leak."""
+        with self._submit_lock:
+            self._backlog -= 1
+
+    def queue_depth(self) -> int:
+        """Live outstanding-request count (queued + coalescing + in
+        flight) — the admission-control gauge the HTTP front door's
+        watermark reads, exported as ``queue_depth`` in
+        ``stats.summary()``."""
+        with self._submit_lock:
+            return self._backlog
+
+    def set_params(self, params) -> None:
+        """Hot weight reload: atomically swap every replica's params
+        between batches (in-flight batches keep the params they were
+        launched with; no request is dropped). The caller validates
+        shapes/dtypes first — the AOT executables take params as a
+        runtime argument, so same-structure params never recompile."""
+        self._pool.set_params(params)
 
     def map_ordered(self, images: Iterable[np.ndarray]) -> List[np.ndarray]:
         """Submit everything, then collect results in submission order —
@@ -256,39 +347,69 @@ class DynamicBatcher:
         if bucket is None or len(pending[bucket]) >= self.max_batch:
             self._flush(bucket, pending.pop(bucket))
 
+    def _eff_deadline(self, req: _Request) -> float:
+        """When this request's bucket must flush on its account: the
+        max_wait coalescing budget, CLAMPED by the request's own deadline
+        — a request with 5 ms left never waits out a 20 ms window it
+        cannot afford."""
+        t = req.t_admit + self.max_wait_s
+        if req.deadline is not None:
+            t = min(t, req.deadline)
+        return t
+
     def _sweep(self, pending: dict) -> None:
-        """Flush every bucket whose oldest ADMITTED request has waited out
-        the max_wait budget (cheap: O(buckets) clock checks)."""
+        """Flush every bucket holding a request whose effective deadline
+        (coalescing budget clamped by its own deadline) has passed
+        (cheap: O(pending requests) clock checks)."""
         now = time.perf_counter()
         for bucket in list(pending):
             reqs = pending[bucket]
-            if reqs and now - reqs[0].t_admit >= self.max_wait_s:
+            if reqs and min(self._eff_deadline(r) for r in reqs) <= now:
                 self._flush(bucket, pending.pop(bucket))
 
     def _next_deadline(self, pending: dict) -> Optional[float]:
-        oldest = None
+        soonest = None
         for reqs in pending.values():
-            if reqs:
-                t = reqs[0].t_admit
-                oldest = t if oldest is None else min(oldest, t)
-        if oldest is None:
+            for r in reqs:
+                t = self._eff_deadline(r)
+                soonest = t if soonest is None else min(soonest, t)
+        if soonest is None:
             return None  # idle: block until the next request
-        return max(0.0, oldest + self.max_wait_s - time.perf_counter())
+        return max(0.0, soonest - time.perf_counter())
 
     def _flush(self, bucket, reqs: List[_Request]) -> None:
         """Hand one coalesced micro-batch to the least-loaded replica.
         Host preprocessing, the async device launch, and the D2H sync all
         happen on that replica's own threads (serving/replicas.py), so
         this dispatcher only ever routes — a slow readback on one device
-        cannot delay coalescing or launches for the others."""
+        cannot delay coalescing or launches for the others. Requests whose
+        deadline has already passed are dropped here with a counter, not
+        computed: a response nobody is waiting for is pure wasted device
+        time under exactly the overload that made it late."""
         if not reqs:
+            return
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for r in reqs:
+            if r.deadline is not None and r.deadline <= now:
+                self.stats.record_deadline_expired()
+                if not r.future.done():
+                    r.future.set_exception(
+                        DeadlineExpired(
+                            "deadline expired while waiting for dispatch; "
+                            "request dropped un-computed"
+                        )
+                    )
+            else:
+                live.append(r)
+        if not live:
             return
         try:
             self._pool.dispatch(
-                bucket, reqs, queue_depth=self._requests.qsize()
+                bucket, live, queue_depth=self._requests.qsize()
             )
         except BaseException as err:
-            for r in reqs:
+            for r in live:
                 if not r.future.done():
                     r.future.set_exception(err)
 

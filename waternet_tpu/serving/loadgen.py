@@ -1,0 +1,204 @@
+"""Closed-loop load generator for the HTTP front door (serving/server.py).
+
+Closed-loop means each of ``concurrency`` workers keeps exactly one
+request outstanding on its own persistent connection — offered load
+tracks the server's actual capacity times the concurrency, which is the
+honest way to find a saturation point (an open-loop generator measures
+its own timer, not the server). Doubling ``concurrency`` past saturation
+is therefore "2x sustainable offered load": the regime where admission
+control must shed rather than queue (the bench.py ``serve_http`` config
+runs exactly that A/B).
+
+Accounting is total: every request ends in exactly one of ``ok`` /
+``shed`` (429) / ``deadline_expired`` (504) / ``rejected`` (other 4xx/
+5xx, e.g. 503 while draining) / ``errors`` (transport), so the overload
+acceptance criterion — no silent drops — is checkable from the report
+alone. Stdlib-only (http.client + threads); worker threads carry the
+pipeline ``THREAD_PREFIX`` so the test suite's leak guard covers them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.serving.stats import _percentile
+
+
+def run_load(
+    url: str,
+    payloads: List[bytes],
+    concurrency: int = 4,
+    total: int = 64,
+    deadline_ms: Optional[float] = None,
+    path: str = "/enhance",
+    timeout: float = 120.0,
+    keep_bodies: bool = False,
+) -> Dict:
+    """Drive ``total`` POSTs at ``path`` with ``concurrency`` closed-loop
+    workers cycling through ``payloads``; returns the accounting report.
+
+    ``keep_bodies=True`` additionally returns ``bodies`` — a list of
+    ``(request_index, status, body_bytes)`` — so byte-identity tests can
+    check every response against the offline path.
+    """
+    u = urlparse(url)
+    host, port = u.hostname, u.port or 80
+    lock = threading.Lock()
+    counts = {
+        "ok": 0, "shed": 0, "deadline_expired": 0, "rejected": 0,
+        "errors": 0,
+    }
+    latencies: List[float] = []
+    bodies: List = []
+    indices = itertools.count()
+
+    def worker():
+        import http.client
+
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            while True:
+                with lock:
+                    i = next(indices)
+                if i >= total:
+                    break
+                payload = payloads[i % len(payloads)]
+                headers = {"Content-Type": "application/octet-stream"}
+                if deadline_ms is not None:
+                    headers["X-Deadline-Ms"] = str(deadline_ms)
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", path, body=payload, headers=headers)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    status = resp.status
+                    closed = (
+                        resp.getheader("Connection", "").lower() == "close"
+                    )
+                except Exception:
+                    with lock:
+                        counts["errors"] += 1
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=timeout
+                    )
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    if status == 200:
+                        counts["ok"] += 1
+                        latencies.append(dt)
+                    elif status == 429:
+                        counts["shed"] += 1
+                    elif status == 504:
+                        counts["deadline_expired"] += 1
+                    else:
+                        counts["rejected"] += 1
+                    if keep_bodies:
+                        bodies.append((i, status, body))
+                if closed:
+                    conn.close()
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=timeout
+                    )
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(
+            target=worker, name=f"{THREAD_PREFIX}-loadgen-{i}", daemon=True
+        )
+        for i in range(max(1, int(concurrency)))
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    lat_sorted = sorted(latencies)
+    report = {
+        "sent": total,
+        **counts,
+        "images_per_sec": round(counts["ok"] / elapsed, 2) if elapsed else 0.0,
+        "elapsed_sec": round(elapsed, 3),
+        "concurrency": int(concurrency),
+        "latency_ms": {
+            "p50": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
+            "p99": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
+        },
+    }
+    if keep_bodies:
+        report["bodies"] = bodies
+    return report
+
+
+def _synthetic_payloads(spec: str, n: int = 8) -> List[bytes]:
+    """``HxW`` -> n deterministic PNG payloads (no dataset needed)."""
+    import cv2
+    import numpy as np
+
+    h, w = (int(x) for x in spec.lower().split("x"))
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".png", img)
+        assert ok
+        out.append(buf.tobytes())
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="waternet-loadgen", description=__doc__
+    )
+    parser.add_argument("--url", type=str, required=True)
+    parser.add_argument(
+        "--source", type=str, default=None,
+        help="Directory of images to POST (defaults to synthetic frames).",
+    )
+    parser.add_argument(
+        "--synthetic", type=str, default="112x150",
+        help="HxW of synthetic payloads when --source is not given.",
+    )
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--requests", type=int, default=64)
+    parser.add_argument("--deadline-ms", type=float, default=None)
+    args = parser.parse_args(argv)
+
+    if args.source:
+        from pathlib import Path
+
+        payloads = [
+            p.read_bytes()
+            for p in sorted(Path(args.source).glob("*"))
+            if p.suffix.lower() in (".png", ".jpg", ".jpeg", ".bmp")
+        ]
+        if not payloads:
+            print(f"no images under {args.source}", file=sys.stderr)
+            return 2
+    else:
+        payloads = _synthetic_payloads(args.synthetic)
+    report = run_load(
+        args.url,
+        payloads,
+        concurrency=args.concurrency,
+        total=args.requests,
+        deadline_ms=args.deadline_ms,
+    )
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.resilience import faults
 from waternet_tpu.serving.bucketing import Bucket, BucketLadder
 from waternet_tpu.serving.stats import ServingStats
 from waternet_tpu.serving.warmup import warmup
@@ -163,6 +164,12 @@ class _Replica:
                 if bucket is None:
                     self._launch_fallback(reqs)
                     continue
+                # Deterministic serving-side fault hook (docs/RESILIENCE.md):
+                # an armed slow_replica@K stalls the K-th launch so drain /
+                # deadline / shed paths can hold work in flight on cue.
+                delay = faults.replica_launch_delay()
+                if delay > 0.0:
+                    time.sleep(delay)
                 n_slots = pool.max_batch
                 exe = self.executables[(bucket, n_slots)]
                 images = [r.image for r in reqs]
@@ -331,6 +338,24 @@ class ReplicaPool:
             # Fallback groups launch one forward per request.
             replica.outstanding += len(reqs) if bucket is None else 1
         replica.work.put((bucket, reqs, queue_depth))
+
+    def set_params(self, params) -> None:
+        """Hot weight reload: place ``params`` on every replica's device
+        and swap each replica's reference between batches.
+
+        Attribute assignment is atomic under the GIL and a launch thread
+        reads ``replica.params`` exactly once per batch, so every batch
+        runs entirely on old or entirely on new weights — in-flight
+        batches complete on the params they were launched with, and no
+        request is dropped. The engine's own params swap too, so oversize
+        fallbacks (replica 0's jit-cache path) serve the new weights as
+        well. Callers validate tree structure / shapes / dtypes first
+        (the AOT executables were lowered against them); see
+        serving/server.py's reload endpoint.
+        """
+        self.engine.params = params
+        for r in self._replicas:
+            r.params = self.engine.replica_params(r.device)
 
     def close(self) -> None:
         """Drain every replica's queued work, stop and join all worker

@@ -1,0 +1,747 @@
+"""Serving front door: an asyncio, stdlib-only HTTP gateway over the
+dynamic batcher (docs/SERVING.md "Front door").
+
+The PR-4/5 serving engine was fast but unreachable — only the
+`inference.py` CLI could feed it. This process turns it into a service:
+``python -m waternet_tpu.serving.server --weights w.npz`` (or the
+``waternet-serve`` console entry) listens on one port and feeds decoded
+request images straight into the :class:`DynamicBatcher` queue, hardened
+for the traffic patterns a library never sees:
+
+* **Admission control + bounded backpressure.** The batcher's request
+  queue is bounded (``max_queue``); past the ``admit_watermark`` the
+  server sheds with ``429 Too Many Requests`` + ``Retry-After`` instead
+  of queueing forever — under overload, queueing delay and RSS stay
+  bounded and the client is told to back off. Every shed is counted
+  (``shed_count``), and no admitted request is ever silently dropped:
+  each one resolves to a response or a counted deadline expiry.
+* **Per-request deadlines.** An ``X-Deadline-Ms`` header becomes an
+  absolute deadline propagated into the batcher: a budget that cannot be
+  met is rejected up front with ``504``; a pending request whose budget
+  runs out is dropped at dispatch with a counter (not computed); and the
+  deadline CLAMPS the coalescing window, so a lone request never waits
+  out a ``max_wait_ms`` it cannot afford.
+* **Graceful drain.** SIGTERM/SIGINT (latched by the PR-1 resilience
+  control plane's :class:`PreemptionGuard` — a flag, no work in the
+  handler) stops admission (``503`` + ``Connection: close``), drains
+  every in-flight batch through the replica pool, flushes the stats
+  JSON, and exits 0 within ``grace_sec``.
+* **Hot weight reload.** ``POST /admin/reload`` swaps
+  ``replica_params`` atomically between batches without dropping
+  in-flight requests, validating tree structure / shapes / dtypes
+  through the same :func:`params_mismatch_report` path the trainer's
+  restore uses and rolling back (no swap) on mismatch. The AOT
+  executables take params as a runtime argument, so a valid reload
+  never recompiles — the compile-sentinel guarantee holds across it.
+* **Readiness + observability.** ``GET /healthz`` reports ready only
+  after AOT warmup completes (and not-draining); ``GET /stats`` exposes
+  the live :class:`ServingStats` schema (docs/SERVING.md), including
+  ``queue_depth`` / ``shed_count`` / ``deadline_expired``.
+
+Endpoints: ``POST /enhance`` (image file bytes in, PNG out — the body
+is whatever ``cv2.imdecode`` reads, which is exactly what ``cv2.imread``
+reads on the local path, so the CLI and the service stay behaviorally
+interchangeable via ``inference.py --serve-url``); ``GET /healthz``;
+``GET /stats``; ``POST /admin/reload``.
+
+The HTTP layer is deliberately hand-rolled on ``asyncio.start_server``
+(persistent connections, Content-Length bodies): the container bakes no
+HTTP framework, and the protocol surface a batcher front door needs is
+four routes. Request decode / response encode run in the loop's default
+executor so the event loop never blocks on cv2.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import threading
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.resilience import faults
+from waternet_tpu.resilience.preemption import PreemptionGuard
+from waternet_tpu.serving.batcher import (
+    DeadlineExpired,
+    DynamicBatcher,
+    QueueFull,
+    resolve_ladder,
+)
+from waternet_tpu.serving.stats import ServingStats
+
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    409: "Conflict",
+    413: "Payload Too Large",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+    503: "Service Unavailable",
+    504: "Gateway Timeout",
+}
+
+#: Request bodies above this are refused with 413 before buffering: a
+#: front door that buffers arbitrary uploads is an OOM, not a service.
+MAX_BODY_BYTES = 64 << 20
+
+
+class ReloadMismatch(RuntimeError):
+    """Hot reload refused: the new weights do not fit the serving model
+    (tree / shape / dtype diff in ``args[0]``). Nothing was swapped."""
+
+
+def _content_length(headers: dict) -> int:
+    """Parsed Content-Length, 0 for absent/malformed/negative — the ONE
+    parse both the reader and the router use, so a header like ``abc``
+    (or ``-1``, which would make ``readexactly`` raise) degrades to an
+    empty body instead of an unhandled ValueError."""
+    try:
+        return max(0, int(headers.get("content-length", "0")))
+    except ValueError:
+        return 0
+
+
+def _decode_request_image(body: bytes):
+    """Image file bytes -> (bgr, rgb) exactly as the local CLI decodes
+    them (``cv2.imdecode`` == ``cv2.imread`` on file bytes), or None.
+
+    None for anything undecodable, INCLUDING the empty body: imdecode
+    returns None for garbage bytes but RAISES on an empty buffer, and a
+    raise here would kill the connection handler instead of answering
+    400."""
+    import cv2
+
+    if not body:
+        return None
+    try:
+        bgr = cv2.imdecode(np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR)
+    except cv2.error:
+        return None
+    if bgr is None:
+        return None
+    return cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+
+
+def _encode_response_png(rgb: np.ndarray) -> bytes:
+    """Enhanced RGB -> PNG bytes in file orientation (BGR), the inverse
+    of :func:`_decode_request_image` — a client that imdecodes + imwrites
+    the response produces byte-identical files to local serving."""
+    import cv2
+
+    ok, buf = cv2.imencode(".png", cv2.cvtColor(rgb, cv2.COLOR_RGB2BGR))
+    if not ok:
+        raise RuntimeError("PNG encode failed")
+    return buf.tobytes()
+
+
+class ServingServer:
+    """One HTTP front door over one engine + one :class:`DynamicBatcher`.
+
+    Lifecycle: construct (cheap — no jax work), then either
+    :meth:`run` (blocking; the ``main()`` path, installs the
+    PreemptionGuard) or :meth:`start_background` (tests/bench: serves
+    from a daemon thread; stop with :meth:`request_drain` +
+    :meth:`join`). The batcher — and its AOT warmup — is built on a
+    background thread after the socket is already listening, so
+    ``/healthz`` answers (not ready) during warmup and a load balancer
+    can health-check a starting server.
+    """
+
+    def __init__(
+        self,
+        engine,
+        ladder,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_batch: int = 8,
+        max_wait_ms: float = 10.0,
+        replicas=1,
+        max_queue: int = 256,
+        admit_watermark: Optional[int] = None,
+        grace_sec: float = 30.0,
+        min_deadline_ms: float = 0.0,
+        stats: Optional[ServingStats] = None,
+    ):
+        if admit_watermark is None:
+            # Shed before QueueFull would fire: the watermark is the soft
+            # limit with headroom for requests already racing past it.
+            admit_watermark = max(1, (3 * max_queue) // 4)
+        self.engine = engine
+        self.ladder = ladder
+        self.host = host
+        self.port = int(port)
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.replicas = replicas
+        self.max_queue = int(max_queue)
+        self.admit_watermark = int(admit_watermark)
+        self.grace_sec = float(grace_sec)
+        self.min_deadline_ms = float(min_deadline_ms)
+        self.stats = stats if stats is not None else ServingStats()
+        self.batcher: Optional[DynamicBatcher] = None
+        self.bound_port: Optional[int] = None
+        self.ready = threading.Event()
+        self.draining = threading.Event()
+        self._bound = threading.Event()
+        self._drain_flag = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._exit_code: Optional[int] = None
+        self._error: Optional[BaseException] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def run(self, install_signal_handlers: bool = True) -> int:
+        """Serve until drain completes; returns the process exit code
+        (0 = clean drain within the grace window)."""
+        return asyncio.run(self._main(install_signal_handlers))
+
+    def start_background(self, timeout: float = 30.0) -> "ServingServer":
+        """Tests/bench entry: serve from a daemon thread (no signal
+        handlers — trigger shutdown with :meth:`request_drain`). Returns
+        once the socket is bound (``bound_port`` is set); warmup may
+        still be running — poll :meth:`wait_ready`."""
+
+        def _target():
+            try:
+                self._exit_code = self.run(install_signal_handlers=False)
+            except BaseException as err:  # surfaced by wait_ready/join
+                self._error = err
+                self._exit_code = 1
+                self._bound.set()
+
+        self._thread = threading.Thread(
+            target=_target, name=f"{THREAD_PREFIX}-serve-http", daemon=True
+        )
+        self._thread.start()
+        if not self._bound.wait(timeout):
+            raise RuntimeError("server did not bind within the timeout")
+        if self._error is not None:
+            raise RuntimeError("server failed to start") from self._error
+        return self
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self.ready.wait(0.1):
+            if self._error is not None:
+                raise RuntimeError("server died during warmup") from self._error
+            if time.monotonic() > deadline:
+                raise RuntimeError("server warmup did not finish in time")
+
+    def request_drain(self) -> None:
+        """Thread-safe drain trigger — what SIGTERM does, callable."""
+        self._drain_flag = True
+
+    def join(self, timeout: float = 120.0) -> int:
+        """Wait for a background server to finish; returns its exit code."""
+        assert self._thread is not None, "server was not started in background"
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise RuntimeError("server did not exit within the timeout")
+        return int(self._exit_code)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.bound_port}"
+
+    async def _main(self, install_signals: bool) -> int:
+        guard = PreemptionGuard() if install_signals else None
+        if guard is not None:
+            guard.__enter__()
+        server = None
+        try:
+            server = await asyncio.start_server(
+                self._handle_connection, self.host, self.port
+            )
+            self.bound_port = server.sockets[0].getsockname()[1]
+            self._bound.set()
+            print(
+                f"waternet-serve: listening on http://{self.host}:"
+                f"{self.bound_port}",
+                flush=True,
+            )
+
+            # AOT warmup in the executor: /healthz answers (503,
+            # ready:false) the whole time, so orchestrators see a
+            # live-but-not-ready process instead of a connection refusal.
+            def _build_batcher():
+                return DynamicBatcher(
+                    self.engine,
+                    self.ladder,
+                    max_batch=self.max_batch,
+                    max_wait_ms=self.max_wait_ms,
+                    stats=self.stats,
+                    replicas=self.replicas,
+                    max_queue=self.max_queue,
+                )
+
+            loop = asyncio.get_running_loop()
+            self.batcher = await loop.run_in_executor(None, _build_batcher)
+            self.ready.set()
+            print(
+                f"waternet-serve: ready ({len(self.ladder)} buckets x "
+                f"{self.batcher.n_replicas} replicas warmed, batch "
+                f"{self.batcher.max_batch})",
+                flush=True,
+            )
+
+            # Serve until a drain is requested (signal or request_drain).
+            while not (
+                self._drain_flag or (guard is not None and guard.requested)
+            ):
+                await asyncio.sleep(0.05)
+
+            # Drain: admission is off the moment this is set (handlers
+            # answer 503 + Connection: close); everything already
+            # admitted flows through the replica pool to completion.
+            self.draining.set()
+            print("waternet-serve: draining", flush=True)
+            self.batcher.drain()  # flush partial batches immediately
+            deadline = time.monotonic() + self.grace_sec
+            clean = False
+            while time.monotonic() < deadline:
+                with self._inflight_lock:
+                    inflight = self._inflight
+                if inflight == 0 and self.batcher.queue_depth() == 0:
+                    clean = True
+                    break
+                await asyncio.sleep(0.02)
+            # Let the last response bytes reach their sockets before the
+            # loop (and its connections) goes away.
+            await asyncio.sleep(0.05)
+            return 0 if clean else 1
+        finally:
+            if server is not None:
+                server.close()
+                await server.wait_closed()
+            if self.batcher is not None:
+                self.batcher.close()
+            if guard is not None:
+                guard.__exit__(None, None, None)
+            # Stats flush: the drain contract — the run's numbers survive
+            # the process, in the same JSON block the CLI prints.
+            print(self.stats.to_json(), flush=True)
+
+    # -- HTTP plumbing -------------------------------------------------
+
+    async def _handle_connection(self, reader, writer):
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    break
+                keep = await self._dispatch(req, writer)
+                await writer.drain()
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # client went away; nothing to answer
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(
+        self, reader
+    ) -> Optional[Tuple[str, str, dict, bytes]]:
+        """One HTTP/1.1 request -> (method, path, headers, body); None on
+        a cleanly closed connection."""
+        # readline converts LimitOverrunError to ValueError past the
+        # stream's 64 KiB limit — an oversized request/header line from a
+        # hostile client must close the connection, not kill the handler.
+        try:
+            line = await reader.readline()
+        except (ConnectionError, asyncio.LimitOverrunError, ValueError):
+            return None
+        if not line or not line.strip():
+            return None
+        parts = line.decode("latin-1").strip().split()
+        if len(parts) < 2:
+            return None
+        method, target = parts[0].upper(), parts[1]
+        headers = {}
+        while True:
+            try:
+                line = await reader.readline()
+            except (ConnectionError, asyncio.LimitOverrunError, ValueError):
+                return None
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            name, _, value = line.decode("latin-1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = _content_length(headers)
+        if length > MAX_BODY_BYTES:
+            return (method, target, headers, b"")  # handler answers 413
+        body = await reader.readexactly(length) if length else b""
+        return method, target.split("?", 1)[0], headers, body
+
+    def _respond(
+        self,
+        writer,
+        status: int,
+        body: bytes,
+        ctype: str = "application/json",
+        extra=(),
+        close: bool = False,
+    ) -> bool:
+        head = (
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+        )
+        for name, value in extra:
+            head += f"{name}: {value}\r\n"
+        if close:
+            head += "Connection: close\r\n"
+        writer.write(head.encode("latin-1") + b"\r\n" + body)
+        return not close
+
+    def _json(self, writer, status, payload, extra=(), close=False) -> bool:
+        return self._respond(
+            writer,
+            status,
+            json.dumps(payload).encode(),
+            extra=extra,
+            close=close,
+        )
+
+    # -- routing -------------------------------------------------------
+
+    async def _dispatch(self, req, writer) -> bool:
+        method, path, headers, body = req
+        want_close = headers.get("connection", "").lower() == "close"
+        if _content_length(headers) > MAX_BODY_BYTES:
+            return self._json(
+                writer, 413, {"error": "payload too large"}, close=True
+            )
+        if path == "/healthz":
+            return self._healthz(writer) and not want_close
+        if path == "/stats":
+            return (
+                self._json(writer, 200, self.stats.summary())
+                and not want_close
+            )
+        if path in ("/enhance", "/v1/enhance"):
+            if method != "POST":
+                return self._json(
+                    writer, 405, {"error": "POST image bytes to /enhance"}
+                )
+            return await self._enhance(headers, body, writer) and not want_close
+        if path == "/admin/reload":
+            if method != "POST":
+                return self._json(
+                    writer, 405, {"error": "POST {\"weights\": path}"}
+                )
+            return await self._reload(body, writer) and not want_close
+        return self._json(writer, 404, {"error": f"no route {path}"})
+
+    def _healthz(self, writer) -> bool:
+        ready = self.ready.is_set() and not self.draining.is_set()
+        payload = {
+            "ready": ready,
+            "warmed": self.ready.is_set(),
+            "draining": self.draining.is_set(),
+        }
+        return self._json(writer, 200 if ready else 503, payload)
+
+    # -- /enhance ------------------------------------------------------
+
+    async def _enhance(self, headers, body, writer) -> bool:
+        if self.draining.is_set():
+            # Drain contract: late arrivals are refused AND the
+            # connection closes, so pooled clients re-resolve elsewhere.
+            return self._json(
+                writer, 503, {"error": "draining"}, close=True
+            )
+        if not self.ready.is_set():
+            return self._json(
+                writer,
+                503,
+                {"error": "warming up"},
+                extra=(("Retry-After", "1"),),
+            )
+
+        # Deadline parse + up-front feasibility: a budget the server
+        # already knows it cannot meet is refused before it queues.
+        deadline = None
+        raw = headers.get("x-deadline-ms")
+        if raw is not None:
+            try:
+                budget_ms = float(raw)
+            except ValueError:
+                return self._json(
+                    writer, 400, {"error": f"bad X-Deadline-Ms {raw!r}"}
+                )
+            if budget_ms <= 0 or budget_ms < self.min_deadline_ms:
+                self.stats.record_deadline_expired()
+                return self._json(
+                    writer,
+                    504,
+                    {
+                        "error": "deadline cannot be met",
+                        "budget_ms": budget_ms,
+                        "min_deadline_ms": self.min_deadline_ms,
+                    },
+                )
+            deadline = time.perf_counter() + budget_ms / 1e3
+
+        # Admission control: the deterministic fault hook, then the
+        # queue-depth watermark — both shed with 429 + Retry-After.
+        if faults.admit_should_reject():
+            self.stats.record_shed()
+            return self._json(
+                writer,
+                429,
+                {"error": "admission rejected (fault injection)"},
+                extra=(("Retry-After", "1"),),
+            )
+        depth = self.batcher.queue_depth()
+        if depth >= self.admit_watermark:
+            self.stats.record_shed()
+            return self._json(
+                writer,
+                429,
+                {"error": "overloaded", "queue_depth": depth},
+                extra=(("Retry-After", "1"),),
+            )
+
+        loop = asyncio.get_running_loop()
+        # In-flight from BEFORE the decode: the drain poll must not see
+        # zero while an admitted request is still in the executor — the
+        # batcher would close under it and drop an accepted request.
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            rgb = await loop.run_in_executor(
+                None, _decode_request_image, body
+            )
+            if rgb is None:
+                return self._json(
+                    writer, 400, {"error": "body is not a decodable image"}
+                )
+            try:
+                fut = self.batcher.submit(rgb, deadline=deadline)
+            except QueueFull as err:
+                return self._json(
+                    writer,
+                    429,
+                    {"error": str(err)},
+                    extra=(("Retry-After", "1"),),
+                )
+            except DeadlineExpired as err:
+                return self._json(writer, 504, {"error": str(err)})
+            except RuntimeError:
+                # Batcher closed between the draining check and submit
+                # (drain finished while we decoded): a late arrival.
+                return self._json(
+                    writer, 503, {"error": "draining"}, close=True
+                )
+            try:
+                out = await asyncio.wrap_future(fut)
+            except DeadlineExpired as err:
+                return self._json(writer, 504, {"error": str(err)})
+            except Exception as err:
+                return self._json(
+                    writer, 500, {"error": f"{type(err).__name__}: {err}"}
+                )
+            png = await loop.run_in_executor(None, _encode_response_png, out)
+            keep = self._respond(writer, 200, png, ctype="image/png")
+            # Flush before the in-flight decrement: the drain poll must
+            # not declare the server empty while this response is still
+            # in the transport's user-space buffer — asyncio.run would
+            # cancel the handler and truncate it on a slow client.
+            await writer.drain()
+            return keep
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    # -- /admin/reload -------------------------------------------------
+
+    def _do_reload(self, path: str):
+        """Load + validate + swap (worker thread). Any raise = rollback:
+        nothing is swapped until validation passes."""
+        from waternet_tpu.hub import resolve_weights
+        from waternet_tpu.utils.checkpoint import params_mismatch_report
+
+        if getattr(self.engine, "quantized", False):
+            raise ReloadMismatch(
+                "quantized engines cannot hot-reload raw weights (the "
+                "serving params are a calibrated int8 tree); restart with "
+                "the new checkpoint instead"
+            )
+        new = resolve_weights(path)
+        if new is None:
+            raise FileNotFoundError(f"no weights at {path!r}")
+        report = params_mismatch_report(
+            new, self.engine.params, check_dtype=True
+        )
+        if report:
+            raise ReloadMismatch(
+                f"new weights do not fit the serving model — rolling back "
+                f"(in-flight and future requests keep the current "
+                f"weights):\n{report}"
+            )
+        self.batcher.set_params(new)
+
+    async def _reload(self, body, writer) -> bool:
+        if not self.ready.is_set() or self.draining.is_set():
+            return self._json(
+                writer, 503, {"error": "not ready for reload"}
+            )
+        try:
+            payload = json.loads(body or b"{}")
+            path = payload["weights"]  # TypeError when payload isn't a dict
+        except (ValueError, KeyError, TypeError):
+            return self._json(
+                writer,
+                400,
+                {"error": 'body must be JSON {"weights": "<path>"}'},
+            )
+        loop = asyncio.get_running_loop()
+
+        def _locked_reload():
+            # Lock taken INSIDE the worker thread: acquiring it on the
+            # event loop would block the loop on a concurrent reload.
+            with self._reload_lock:
+                self._do_reload(path)
+
+        try:
+            await loop.run_in_executor(None, _locked_reload)
+        except ReloadMismatch as err:
+            return self._json(
+                writer, 409, {"error": str(err), "reloaded": False}
+            )
+        except Exception as err:
+            return self._json(
+                writer,
+                400,
+                {
+                    "error": f"{type(err).__name__}: {err}",
+                    "reloaded": False,
+                },
+            )
+        print(f"waternet-serve: reloaded weights from {path}", flush=True)
+        return self._json(writer, 200, {"reloaded": True, "weights": path})
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="waternet-serve", description=__doc__
+    )
+    parser.add_argument("--host", type=str, default="127.0.0.1")
+    parser.add_argument(
+        "--port", type=int, default=8080,
+        help="0 = ephemeral (the chosen port is printed on the "
+        "'listening on' line)",
+    )
+    parser.add_argument(
+        "--weights", type=str, default=None,
+        help="Model weights (.npz native or reference .pt); defaults to "
+        "local weight resolution.",
+    )
+    parser.add_argument(
+        "--serve-buckets", type=str, default="auto",
+        help="Compile-bucket ladder: 'auto' (the default square ladder — "
+        "a server has no directory to scan) or an explicit comma list "
+        "like '256,512,1080x1920'.",
+    )
+    parser.add_argument(
+        "--max-batch", type=int, default=8,
+        help="Compiled batch-slot count per bucket.",
+    )
+    parser.add_argument(
+        "--max-wait-ms", type=float, default=10.0,
+        help="Coalescing window: flush a partial batch once its oldest "
+        "request waited this long (per-request deadlines clamp it).",
+    )
+    parser.add_argument(
+        "--serve-replicas", type=str, default="auto",
+        help="Replica-pool size: 'auto' (every local device) or N.",
+    )
+    parser.add_argument(
+        "--max-queue", type=int, default=256,
+        help="Hard bound on OUTSTANDING requests — queued, coalescing, "
+        "or in flight on a replica (QueueFull past it; each one holds "
+        "host RAM until its response resolves).",
+    )
+    parser.add_argument(
+        "--admit-watermark", type=int, default=None,
+        help="Queue depth past which admission sheds with 429 + "
+        "Retry-After (default: 3/4 of --max-queue).",
+    )
+    parser.add_argument(
+        "--grace-sec", type=float, default=30.0,
+        help="Drain window after SIGTERM: in-flight work must finish "
+        "within it for exit 0.",
+    )
+    parser.add_argument(
+        "--min-deadline-ms", type=float, default=0.0,
+        help="Reject X-Deadline-Ms budgets below this up front with 504 "
+        "(operators set it to their known serving floor; 0 disables).",
+    )
+    parser.add_argument(
+        "--device-preprocess", action="store_true", default=False,
+        help="Run WB/GC/CLAHE on the accelerator (ops/masked.py).",
+    )
+    parser.add_argument(
+        "--precision", type=str, default="fp32", choices=["fp32", "bf16"],
+    )
+    return parser.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from waternet_tpu.utils.platform import (
+        enable_compile_cache,
+        ensure_platform,
+    )
+
+    ensure_platform()
+    enable_compile_cache()
+    faults.install_from_env()  # WATERNET_FAULTS serving-side fault kinds
+
+    import jax.numpy as jnp
+
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    engine = InferenceEngine(
+        weights=args.weights,
+        device_preprocess=args.device_preprocess,
+        dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+    )
+    ladder = resolve_ladder(args.serve_buckets)
+    server = ServingServer(
+        engine,
+        ladder,
+        host=args.host,
+        port=args.port,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        replicas=args.serve_replicas,
+        max_queue=args.max_queue,
+        admit_watermark=args.admit_watermark,
+        grace_sec=args.grace_sec,
+        min_deadline_ms=args.min_deadline_ms,
+    )
+    return server.run(install_signal_handlers=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

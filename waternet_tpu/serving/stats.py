@@ -45,6 +45,16 @@ class ServingStats:
       mid-serve compile for a *bucketed* request is a bug — the
       compile-sentinel test pins that it never happens. A replica pool
       warms ``len(buckets) x replicas`` executables;
+    * **admission-control counters** (the front-door schema,
+      docs/SERVING.md "Front door"): ``shed_count`` — requests refused
+      at admission (queue watermark, ``QueueFull``, or an armed
+      ``reject_admit`` fault); ``deadline_expired`` — requests whose
+      ``X-Deadline-Ms`` budget ran out (rejected up front or dropped
+      un-computed at dispatch); ``queue_depth`` — the LIVE
+      outstanding-request backlog (queued, coalescing, or in flight on
+      a replica), read through the probe the
+      owning :class:`~waternet_tpu.serving.batcher.DynamicBatcher`
+      registers (0 for stats objects nothing registered on);
     * **per-replica** occupancy / mean latency / busy seconds, plus the
       aggregate **images_per_sec** (requests completed over the
       first-dispatch -> last-completion span) and **load_imbalance**
@@ -63,6 +73,13 @@ class ServingStats:
         self.padded_px = 0
         self.compiles = 0
         self.fallback_native = 0
+        self.shed = 0
+        self.deadline_expired = 0
+        #: Live queue-depth gauge: a zero-arg callable the owning batcher
+        #: registers (DynamicBatcher.queue_depth). Left None, the summary
+        #: reports 0 — stats objects riding an ExactShapeBatcher or a bare
+        #: test have no queue to report.
+        self.queue_depth_probe = None
         self._depth_sum = 0
         self.depth_max = 0
         self.replicas = 1
@@ -131,6 +148,18 @@ class ServingStats:
     def record_compile(self, n: int = 1) -> None:
         with self._lock:
             self.compiles += n
+
+    def record_shed(self) -> None:
+        """One request refused at admission (watermark / QueueFull /
+        reject_admit fault) — load that was shed, not served."""
+        with self._lock:
+            self.shed += 1
+
+    def record_deadline_expired(self) -> None:
+        """One request whose deadline budget ran out before compute —
+        rejected up front or dropped (not computed) at dispatch time."""
+        with self._lock:
+            self.deadline_expired += 1
 
     def record_fallback(self) -> None:
         with self._lock:
@@ -223,6 +252,9 @@ class ServingStats:
             compiles = self.compiles
             fallback = self.fallback_native
             replicas = self.replicas
+            shed = self.shed
+            expired = self.deadline_expired
+            probe = self.queue_depth_probe
         return {
             "requests": requests,
             "batches": batches,
@@ -231,6 +263,9 @@ class ServingStats:
             "padding_overhead": round(self.padding_overhead(), 4),
             "compiles": compiles,
             "fallback_native_shapes": fallback,
+            "shed_count": shed,
+            "deadline_expired": expired,
+            "queue_depth": int(probe()) if probe is not None else 0,
             "queue_depth_mean": round(depth_mean, 2),
             "queue_depth_max": depth_max,
             "replicas": replicas,

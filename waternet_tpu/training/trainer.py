@@ -58,6 +58,13 @@ from waternet_tpu.training.losses import (
 from waternet_tpu.training.metrics import psnr as psnr_fn
 from waternet_tpu.training.metrics import ssim as ssim_fn
 
+# The tree-diff lives in utils/checkpoint.py so the serving front door's
+# hot weight reload validates through the SAME path the trainer restore
+# uses — one vocabulary for "this checkpoint does not fit".
+from waternet_tpu.utils.checkpoint import (
+    params_mismatch_report as _params_mismatch_report,
+)
+
 TRAIN_METRICS_NAMES = ["mse", "ssim", "psnr", "perceptual_loss", "loss"]
 VAL_METRICS_NAMES = ["mse", "ssim", "psnr", "perceptual_loss"]
 
@@ -103,37 +110,6 @@ class CheckpointMismatchError(ValueError):
     mismatch aborts with the shape report (falling back would silently
     retrain from scratch — every checkpoint would "fail" identically).
     """
-
-
-def _param_shapes(tree) -> dict:
-    """Flat ``{"a/b/c": shape}`` view of a nested param pytree."""
-    import numpy as np
-
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = "/".join(
-            str(p.key) if hasattr(p, "key") else str(getattr(p, "idx", p))
-            for p in path
-        )
-        flat[key] = tuple(np.shape(leaf))
-    return flat
-
-
-def _params_mismatch_report(ckpt_params, model_params) -> str:
-    """Human-readable diff of two param trees; empty string when they fit."""
-    ck, mo = _param_shapes(ckpt_params), _param_shapes(model_params)
-    lines = []
-    for key in sorted(set(ck) | set(mo)):
-        if key not in ck:
-            lines.append(f"  missing from checkpoint: {key} (model {mo[key]})")
-        elif key not in mo:
-            lines.append(f"  not in model: {key} (checkpoint {ck[key]})")
-        elif ck[key] != mo[key]:
-            lines.append(
-                f"  shape mismatch at {key}: checkpoint {ck[key]} "
-                f"vs model {mo[key]}"
-            )
-    return "\n".join(lines)
 
 
 @dataclasses.dataclass

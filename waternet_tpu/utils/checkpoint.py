@@ -46,6 +46,52 @@ def _unflatten(flat: dict) -> dict:
     return tree
 
 
+def param_shapes(tree, with_dtype: bool = False) -> dict:
+    """Flat ``{"a/b/c": shape}`` (or ``(shape, dtype)``) view of a nested
+    param pytree — the shared vocabulary of every "does this checkpoint
+    fit this model" check (trainer restore, serving hot reload)."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = "/".join(
+            str(p.key) if hasattr(p, "key") else str(getattr(p, "idx", p))
+            for p in path
+        )
+        shape = tuple(np.shape(leaf))
+        if with_dtype:
+            flat[key] = (shape, str(np.asarray(leaf).dtype))
+        else:
+            flat[key] = shape
+    return flat
+
+
+def params_mismatch_report(
+    ckpt_params, model_params, check_dtype: bool = False
+) -> str:
+    """Human-readable diff of two param trees; empty string when they fit.
+
+    The one validation path behind both the trainer's restore (shape
+    check: ``TrainingEngine.restore``) and the serving front door's hot
+    weight reload, which also checks dtypes (``check_dtype=True``) —
+    its AOT executables were lowered against exact dtypes, so an fp32
+    file cannot hot-swap into a bf16-param server.
+    """
+    ck = param_shapes(ckpt_params, with_dtype=check_dtype)
+    mo = param_shapes(model_params, with_dtype=check_dtype)
+    lines = []
+    for key in sorted(set(ck) | set(mo)):
+        if key not in ck:
+            lines.append(f"  missing from checkpoint: {key} (model {mo[key]})")
+        elif key not in mo:
+            lines.append(f"  not in model: {key} (checkpoint {ck[key]})")
+        elif ck[key] != mo[key]:
+            what = "shape/dtype" if check_dtype else "shape"
+            lines.append(
+                f"  {what} mismatch at {key}: checkpoint {ck[key]} "
+                f"vs model {mo[key]}"
+            )
+    return "\n".join(lines)
+
+
 def save_weights(params, path) -> Path:
     """Save a param pytree as a flat npz — atomically.
 
