@@ -27,11 +27,17 @@ variants).
 The host-fed line also carries the overlapped input pipeline's numbers
 (docs/PIPELINE.md): ``pipeline_stall_pct`` (steps that waited on the
 prefetch queue — near 0 proves the overlap), per-stage ms (load /
-preprocess / transfer / step), and ``pipeline_epoch_images_per_sec``
-measured over a real host-fed epoch. A ``_hostfed_sync`` A/B line
-(workers=0, printed BEFORE the host-fed line) measures the identical epoch
-synchronously so the overlap win is visible in one run; disable both with
-WATERNET_BENCH_WORKERS=0.
+preprocess / transfer / step), ``pipeline_transfer_bytes_per_batch`` (the
+H2D payload), and ``pipeline_epoch_images_per_sec`` measured over a real
+host-fed epoch. A ``_hostfed_sync`` A/B line (workers=0, printed BEFORE
+the host-fed line) measures the identical epoch synchronously so the
+overlap win is visible in one run; disable both with
+WATERNET_BENCH_WORKERS=0. It additionally carries the
+``--device-preprocess`` vs ``--host-preprocess`` A/B
+(``devpre_*`` / ``hostpre_*`` images/sec, stall pct, and
+``transfer_bytes_per_batch`` of each arm, plus ``h2d_bytes_reduction`` —
+the ~10x raw-uint8-ingest H2D pin, 2 uint8 tensors vs 5 float32 views);
+disable that arm alone with WATERNET_BENCH_HOSTPRE_AB=0.
 
 ``--config serve`` measures the inference serving path instead: the
 ``mixed_res_dir_images_per_sec`` line A/Bs the shape-bucketed dynamic
@@ -790,7 +796,64 @@ def measure_train(
             )
             line.update(pipe_fields)
             line["hostfed_sync"] = sync_fields  # popped by main() into its own line
+            # --device-preprocess vs --host-preprocess A/B
+            # (WATERNET_BENCH_HOSTPRE_AB=0 disables: the host-pre arm
+            # compiles its own train_step_pre engine).
+            if _env_int("WATERNET_BENCH_HOSTPRE_AB", 1):
+                line.update(measure_devpre_hostpre_ab(config, pipe_fields))
     return line
+
+
+def measure_devpre_hostpre_ab(config, devpre_fields, epoch_batches=2):
+    """``--device-preprocess`` vs ``--host-preprocess`` A/B for the
+    host-fed contract line.
+
+    The device-preprocess arm is the host-fed line's own pipelined epoch
+    (``devpre_fields`` from :func:`measure_hostfed_pipeline_ab` — raw
+    uint8 ingest, in-step fused preprocessing); this runs the
+    host-preprocess arm (cv2 WB/GC/CLAHE in workers, five float32 views
+    shipped per batch) over the same synthetic workload on a fresh engine
+    and returns the A/B fields: images/sec and stall pct of each arm,
+    plus the pinned per-batch H2D payloads (``*_transfer_bytes_per_batch``)
+    and their ratio ``h2d_bytes_reduction`` (~10x: 5 float32 views vs
+    2 uint8 tensors).
+    """
+    import dataclasses
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    hp_cfg = dataclasses.replace(config, host_preprocess=True)
+    engine = TrainingEngine(hp_cfg)
+    data = SyntheticPairs(
+        epoch_batches * hp_cfg.batch_size, hp_cfg.im_height,
+        hp_cfg.im_width, seed=0,
+    )
+    idx = np.arange(len(data))
+    for i in idx:  # warm the decode cache (same discipline as the A/B above)
+        data.load_pair(int(i))
+    workers = _env_int("WATERNET_BENCH_WORKERS", 2)
+    # Compile warmup on one batch, then one measured pipelined epoch.
+    engine.train_epoch_pipelined(
+        data, idx[: hp_cfg.batch_size], epoch=0, workers=workers
+    )
+    t0 = time.perf_counter()
+    m = engine.train_epoch_pipelined(data, idx, epoch=1, workers=workers)
+    dt = time.perf_counter() - t0
+    dev_bytes = devpre_fields.get("pipeline_transfer_bytes_per_batch", 0.0)
+    host_bytes = m["pipeline_transfer_bytes_per_batch"]
+    return {
+        "devpre_images_per_sec": devpre_fields.get(
+            "pipeline_epoch_images_per_sec"
+        ),
+        "devpre_transfer_bytes_per_batch": dev_bytes,
+        "hostpre_images_per_sec": round(len(idx) / dt, 2),
+        "hostpre_pipeline_stall_pct": m["pipeline_stall_pct"],
+        "hostpre_transfer_bytes_per_batch": host_bytes,
+        "h2d_bytes_reduction": (
+            round(host_bytes / dev_bytes, 2) if dev_bytes else None
+        ),
+    }
 
 
 def measure_hostfed_pipeline_ab(engine, workers, epoch_batches=2):
@@ -1006,14 +1069,17 @@ def _run_benchmark_child(timeout_s: int):
     return None
 
 
-_HEADLINE_STAGE_RE = re.compile(r"^train_bf16(?:_r(\d+))?(_precached)?$")
+_HEADLINE_STAGE_RE = re.compile(r"^train_bf16(?:_r(\d+))?(_precached|_devpre)?$")
+_HEADLINE_SUFFIX_RANK = {None: 0, "_devpre": 1, "_precached": 2}
 
 
 def headline_stage_candidates(stages):
     """ok ``train_bf16`` / ``train_bf16_rN`` / ``train_bf16_rN_precached``
-    session stages as ``[(name, entry), ...]``, newest round first (the bare
-    round-2 name sorts oldest); within a round the precached stage — the
-    contract path since round 4 — outranks the host-fed one. Session stage
+    / ``train_bf16_rN_devpre`` session stages as ``[(name, entry), ...]``,
+    newest round first (the bare round-2 name sorts oldest); within a
+    round the precached stage — the contract path since round 4 —
+    outranks the devpre host-fed stage (round 6's explicit raw-uint8
+    ingest re-measure), which outranks a bare host-fed one. Session stage
     names carry a round tag because resume skips ok stages — each round's
     optimized code is re-measured under a fresh name — and this helper is
     the ONE place that decodes that convention (tools/tpu_session.py's
@@ -1024,7 +1090,12 @@ def headline_stage_candidates(stages):
         m = _HEADLINE_STAGE_RE.match(name)
         if m and entry.get("ok"):
             found.append(
-                (int(m.group(1) or 0), 1 if m.group(2) else 0, name, entry)
+                (
+                    int(m.group(1) or 0),
+                    _HEADLINE_SUFFIX_RANK[m.group(2)],
+                    name,
+                    entry,
+                )
             )
     return [
         (name, entry)

@@ -66,7 +66,7 @@ def test_transform_variants_disable_device_cache(ab):
     by_name = dict(ab.TRAIN_VARIANTS)
     for name in (
         "clahe_interp_gather", "clahe_interp_matmul", "clahe_hist_scatter",
-        "clahe_hist_matmul", "pallas_hist",
+        "clahe_hist_matmul", "pallas_fused",
     ):
         assert by_name[name].get("WATERNET_BENCH_DEVICE_CACHE") == "0", name
     for name in ("default_bf16", "fp32"):

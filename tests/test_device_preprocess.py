@@ -1,0 +1,248 @@
+"""`--device-preprocess` parity pins: the raw-uint8-ingest training mode.
+
+Device preprocessing has been the host-fed default since the step fused
+the classical transforms; this PR names it (`--device-preprocess`),
+collapses the worker stage to decode+stack, routes the in-step stage
+through the shared ops entry (waternet_tpu/ops/fused.py), and pins that
+none of that moved a single bit:
+
+* the fused ops entry == the inline augment/transform/scale composition
+  it replaced, exactly;
+* explicit `--device-preprocess` CLI runs are byte-identical to default
+  runs (CSVs + weights, fp32 and bf16 — heavyweight variants `slow`,
+  with the engine-level exact-equality tests as the tier-1
+  representatives), including mid-epoch SIGTERM resume through
+  WATERNET_FAULTS;
+* mid-epoch resume on the device-preprocess pipelined path replays
+  bit-for-bit (engine level);
+* zero mid-epoch recompiles under the compile sentinel: one warm epoch,
+  then a full train+eval epoch with every armed step cache frozen.
+
+The sibling pins live in tests/test_pipeline.py (pipelined==synchronous
+exact equality, the decode@K raw-uint8 worker fault, and the
+transfer-bytes schema: 2 uint8 tensors vs 5 float32 views per batch).
+"""
+
+import numpy as np
+import pytest
+
+from waternet_tpu.resilience import faults
+
+ARGS = [
+    "--synthetic", "8", "--batch-size", "4", "--height", "32", "--width", "32",
+    "--no-perceptual",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _tiny_config(**kw):
+    from waternet_tpu.training.trainer import TrainConfig
+
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("im_height", 32)
+    kw.setdefault("im_width", 32)
+    kw.setdefault("precision", "fp32")
+    kw.setdefault("perceptual_weight", 0.0)
+    return TrainConfig(**kw)
+
+
+def _run_cli(tmp_base, name, argv, monkeypatch):
+    import train as cli
+    import waternet_tpu.utils.rundir as rundir
+
+    from pathlib import Path
+
+    d = Path(tmp_base) / name
+    monkeypatch.setattr(rundir, "next_run_dir", lambda base, name=None: d)
+    monkeypatch.setattr(
+        rundir,
+        "run_dirs_desc",
+        lambda base: sorted(
+            (p for p in Path(tmp_base).iterdir() if p.is_dir()),
+            key=lambda p: p.stat().st_mtime,
+            reverse=True,
+        ),
+    )
+    cli.main(ARGS + argv)
+    return d
+
+
+def _assert_run_artifacts_identical(a, b):
+    assert (a / "metrics-train.csv").read_bytes() == (
+        b / "metrics-train.csv"
+    ).read_bytes()
+    assert (a / "metrics-val.csv").read_bytes() == (
+        b / "metrics-val.csv"
+    ).read_bytes()
+    wa, wb = np.load(a / "last.npz"), np.load(b / "last.npz")
+    assert sorted(wa.files) == sorted(wb.files)
+    assert all(np.array_equal(wa[k], wb[k]) for k in wa.files)
+
+
+# ----------------------------------------------------------------------
+# Flag semantics
+# ----------------------------------------------------------------------
+
+
+def test_device_preprocess_flag_semantics():
+    """The flag names the default; combining it with --host-preprocess is
+    a loud error, and TrainConfig.device_preprocess mirrors the mode."""
+    import train as cli
+
+    from waternet_tpu.training.trainer import TrainConfig
+
+    args = cli.parse_args(ARGS + ["--device-preprocess"])
+    assert args.device_preprocess and not args.host_preprocess
+    assert TrainConfig(host_preprocess=False).device_preprocess
+    assert not TrainConfig(host_preprocess=True).device_preprocess
+
+    with pytest.raises(SystemExit, match="mutually"):
+        cli.main(ARGS + ["--device-preprocess", "--host-preprocess"])
+
+
+# ----------------------------------------------------------------------
+# The fused ops entry is the inline stage it replaced, bit for bit
+# ----------------------------------------------------------------------
+
+
+def test_fused_entry_matches_inline_composition(rng):
+    """ops.fused_train_preprocess == augment_pair_batch + transform_batch
+    + /255 composed inline (the historical trainer._preprocess body),
+    exactly — with and without augmentation/rng."""
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.data.augment import augment_pair_batch
+    from waternet_tpu.ops import fused_train_preprocess, transform_batch
+
+    raw = jnp.asarray(rng.integers(0, 256, (3, 24, 32, 3), dtype=np.uint8))
+    ref = jnp.asarray(rng.integers(0, 256, (3, 24, 32, 3), dtype=np.uint8))
+    key = jax.random.PRNGKey(7)
+
+    def inline(raw_u8, ref_u8, k, augment):
+        r = raw_u8.astype(jnp.float32)
+        f = ref_u8.astype(jnp.float32)
+        if augment and k is not None:
+            r, f = augment_pair_batch(k, r, f)
+        wb, gc, he = transform_batch(r)
+        return r / 255.0, wb / 255.0, he / 255.0, gc / 255.0, f / 255.0
+
+    for augment, k in [(True, key), (True, None), (False, key)]:
+        want = inline(raw, ref, k, augment)
+        got = fused_train_preprocess(raw, ref, k, augment=augment)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+
+
+# ----------------------------------------------------------------------
+# Engine-level: resume + sentinel on the device-preprocess pipelined path
+# ----------------------------------------------------------------------
+
+
+def test_device_preprocess_midepoch_resume_bit_identical():
+    """start_batch resume of a device-preprocess pipelined epoch replays
+    the remainder bit-for-bit (the raw-uint8 work list skips chunks
+    without loading them; in-step augment draws fold from (epoch, count)
+    so no host RNG fast-forward is even needed)."""
+    import jax
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    # shuffle=False so the first-batch prefix run sees the same batch the
+    # full epoch's plan starts with (as the host-preprocess resume test);
+    # augment stays ON — the in-step draws fold from (epoch, count), which
+    # is exactly what resume must reproduce.
+    cfg = _tiny_config(shuffle=False, augment=True)
+    ds = SyntheticPairs(12, 32, 32, seed=0)
+    idx = np.arange(12)
+
+    full = TrainingEngine(cfg)
+    full.train_epoch_pipelined(ds, idx, epoch=0, workers=2)
+
+    resumed = TrainingEngine(cfg)
+    resumed.train_epoch_pipelined(ds, idx[:4], epoch=0, workers=2)
+    resumed.train_epoch_pipelined(ds, idx, epoch=0, workers=2, start_batch=1)
+
+    a = jax.tree_util.tree_leaves(jax.device_get(full.state))
+    b = jax.tree_util.tree_leaves(jax.device_get(resumed.state))
+    assert all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+
+def test_device_preprocess_zero_midepoch_recompiles(compile_sentinel):
+    """The raw-uint8 step programs are compiled once: a warm epoch, then a
+    full pipelined train epoch + eval epoch with every armed jit cache
+    frozen (the PR-3 sentinel) — including a padded tail batch, whose
+    masking must not introduce a second executable."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    cfg = _tiny_config(shuffle=True, augment=True)
+    ds = SyntheticPairs(10, 32, 32, seed=0)  # tail batch of 2: pad + mask
+    idx = np.arange(10)
+    eng = TrainingEngine(cfg)
+    eng.train_epoch_pipelined(ds, idx, epoch=0, workers=2)  # warm/compile
+    eng.eval_epoch_pipelined(ds, idx, workers=2)
+    compile_sentinel.arm_engine(eng)
+    eng.train_epoch_pipelined(ds, idx, epoch=1, workers=2)
+    eng.eval_epoch_pipelined(ds, idx, workers=2)
+    compile_sentinel.check()
+
+
+# ----------------------------------------------------------------------
+# CLI-level byte identity (heavyweight variants: slow tier)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_device_preprocess_cli_byte_identical_fp32_with_fault_resume(
+    tmp_path, monkeypatch
+):
+    """Explicit `--device-preprocess` runs are byte-for-byte the default
+    run's CSVs and weights (fp32), and the WATERNET_FAULTS composition
+    holds: a SIGTERM mid-epoch through the explicit flag checkpoints the
+    exact position and the resumed run reproduces the uninterrupted
+    default baseline byte-for-byte."""
+    import json
+
+    extra = ["--epochs", "2", "--precision", "fp32"]
+    base = _run_cli(tmp_path / "base", "d", extra, monkeypatch)
+    explicit = _run_cli(
+        tmp_path / "x", "x", ["--device-preprocess"] + extra, monkeypatch
+    )
+    _assert_run_artifacts_identical(base, explicit)
+
+    work = tmp_path / "work"
+    faults.install(faults.FaultPlan.parse("sigterm@3"))
+    interrupted = _run_cli(
+        work, "0", ["--device-preprocess"] + extra, monkeypatch
+    )
+    faults.clear()
+    cks = sorted((interrupted / "checkpoints").glob("step-*"))
+    meta = json.loads((cks[-1] / "_COMPLETE.json").read_text())
+    assert (meta["epoch"], meta["batch_index"]) == (1, 1)
+
+    resumed = _run_cli(
+        work, "1",
+        ["--device-preprocess", "--resume", "auto"] + extra, monkeypatch,
+    )
+    _assert_run_artifacts_identical(base, resumed)
+
+
+@pytest.mark.slow
+def test_device_preprocess_cli_byte_identical_bf16(tmp_path, monkeypatch):
+    """Same artifact-level byte identity in the production bf16 config."""
+    extra = ["--epochs", "1", "--precision", "bf16"]
+    base = _run_cli(tmp_path / "base", "d", extra, monkeypatch)
+    explicit = _run_cli(
+        tmp_path / "x", "x", ["--device-preprocess"] + extra, monkeypatch
+    )
+    _assert_run_artifacts_identical(base, explicit)

@@ -25,7 +25,8 @@ def test_tile_histogram_chunked_accumulation(rng):
 
 
 def test_clahe_with_pallas_histogram_bitexact(sample_rgb):
-    """Full CLAHE using the Pallas histogram == cv2, bit for bit."""
+    """Full CLAHE through BOTH fused Pallas kernels (tile_lut +
+    clahe_lut_planes, selected by use_pallas=True) == cv2, bit for bit."""
     import cv2
 
     from waternet_tpu.ops.clahe import clahe
@@ -35,3 +36,128 @@ def test_clahe_with_pallas_histogram_bitexact(sample_rgb):
     # On CPU the kernel auto-selects interpreter mode.
     got = np.asarray(clahe(lum.astype(np.float32), use_pallas=True))
     np.testing.assert_array_equal(got, want.astype(np.float32))
+
+
+# ----------------------------------------------------------------------
+# Fused histogram -> clip -> CDF -> LUT kernel (tile_lut)
+# ----------------------------------------------------------------------
+
+
+def _lax_luts(tiles, area):
+    """The lax reference pipeline the kernel must match bit-for-bit."""
+    import jax.numpy as jnp2  # noqa: F401
+
+    from waternet_tpu.ops.clahe import _luts_from_hist, _tile_hist
+
+    clip = max(int(0.1 * area / 256.0), 1)
+    scale = np.float32(255.0) / np.float32(area)
+    hist = _tile_hist(jnp.asarray(tiles, jnp.int32), None)
+    return np.asarray(_luts_from_hist(hist, clip, scale)), clip, scale
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.float32], ids=["u8", "f32"])
+@pytest.mark.parametrize(
+    "t,area", [(4, 196), (3, 77), (9, 121), (5, 2048), (1, 5000)]
+)
+def test_tile_lut_matches_lax_pipeline(rng, t, area, dtype):
+    """Fused kernel == lax _tile_hist + _luts_from_hist, bit for bit,
+    including odd tile counts/areas and multi-chunk accumulation, for
+    integer- and float-typed inputs."""
+    from waternet_tpu.ops.pallas_kernels import tile_lut
+
+    tiles = rng.integers(0, 256, size=(t, area)).astype(dtype)
+    want, clip, scale = _lax_luts(tiles, area)
+    got = np.asarray(
+        tile_lut(jnp.asarray(tiles), clip, scale, interpret=True)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tile_lut_chunked_accumulation(rng):
+    """Areas spanning multiple 2048-pixel chunks: the clip/CDF finalizer
+    must see the FULLY accumulated histogram, not the last chunk's."""
+    from waternet_tpu.ops.pallas_kernels import tile_lut
+
+    area = 3 * 2048 + 17
+    tiles = rng.integers(0, 256, size=(2, area))
+    want, clip, scale = _lax_luts(tiles, area)
+    got = np.asarray(
+        tile_lut(jnp.asarray(tiles), clip, scale, interpret=True)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------------------------------
+# Fused LUT-interpolation kernel (clahe_lut_planes) + strategy gating
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.float32], ids=["u8", "f32"])
+@pytest.mark.parametrize(
+    "hw,grid",
+    [
+        ((19, 23), (3, 4)),  # odd everything: 1-px cells both axes
+        ((33, 17), (5, 3)),  # odd tiles, divisibility padding
+        ((40, 56), (4, 7)),  # even-H cells, odd-W cells
+        ((64, 64), (8, 8)),  # the even half-tile cell fast path
+    ],
+)
+def test_clahe_pallas_matches_lax_odd_grids(rng, hw, grid, dtype):
+    """Full CLAHE with both Pallas kernels == the lax fallback, bit for
+    bit, across odd tile grids (cells degrade to single rows/columns) and
+    both input dtypes."""
+    from waternet_tpu.ops.clahe import clahe
+
+    im = rng.integers(0, 256, size=hw).astype(dtype)
+    got = np.asarray(clahe(jnp.asarray(im), tile_grid=grid, use_pallas=True))
+    want = np.asarray(clahe(jnp.asarray(im), tile_grid=grid, use_pallas=False))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_clahe_pallas_cell_subdivision_bitexact(rng, monkeypatch):
+    """A tiny per-block cap forces the interp kernel's cell subdivision
+    (more, smaller blocks) — still bit-identical to the lax path."""
+    import importlib
+
+    # (attribute import: the ops package re-exports the clahe FUNCTION
+    # under the submodule's name, shadowing `waternet_tpu.ops.clahe`)
+    clahe_mod = importlib.import_module("waternet_tpu.ops.clahe")
+
+    im = rng.integers(0, 256, size=(64, 64)).astype(np.float32)
+    want = np.asarray(
+        clahe_mod.clahe(jnp.asarray(im), use_pallas=False)
+    )
+    monkeypatch.setattr(clahe_mod, "_PALLAS_INTERP_BLOCK_CAP", 2048)
+    got = np.asarray(clahe_mod.clahe(jnp.asarray(im), use_pallas=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_enabled_gating_and_fallback(sample_rgb, monkeypatch):
+    """pallas_enabled() (WATERNET_PALLAS=1) routes BOTH CLAHE strategies
+    to the kernels with no per-call argument; without it the lax fallback
+    is selected — and the two paths are bit-identical end to end through
+    histeq (the fallback-path pin)."""
+    import cv2
+
+    from waternet_tpu.ops.clahe import _hist_mode, _interp_mode, clahe
+    from waternet_tpu.ops.pallas_kernels import pallas_enabled
+
+    monkeypatch.delenv("WATERNET_PALLAS", raising=False)
+    assert not pallas_enabled()
+    assert _hist_mode(None) == "scatter"  # CPU auto
+    assert _interp_mode(14, 14) == "gather"
+
+    lum = cv2.cvtColor(sample_rgb, cv2.COLOR_RGB2LAB)[:, :, 0]
+    fallback = np.asarray(clahe(lum.astype(np.float32)))
+
+    monkeypatch.setenv("WATERNET_PALLAS", "1")
+    assert pallas_enabled()
+    assert _hist_mode(None) == "pallas"
+    assert _interp_mode(14, 14) == "pallas"
+    kernel = np.asarray(clahe(lum.astype(np.float32)))
+    np.testing.assert_array_equal(kernel, fallback)
+
+    # Explicit argument still wins over the env (same contract as
+    # _hist_mode): a test pinning the lax path must not be rerouted.
+    assert _hist_mode(False) == "scatter"
+    assert _interp_mode(14, 14, use_pallas=False) == "gather"

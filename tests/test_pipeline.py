@@ -204,13 +204,27 @@ def test_pipelined_epoch_matches_synchronous(host_preprocess):
         )
         for k in m_sync:
             assert m_sync[k] == m_pipe[k], (epoch, k, m_sync[k], m_pipe[k])
-        # The instrumentation contract: stall counter + per-stage timings.
+        # The instrumentation contract: stall counter + per-stage timings
+        # + the H2D payload counter (schema pinned here).
         assert "pipeline_stall_pct" in m_pipe
         assert m_pipe["pipeline_workers"] == 2.0
         for stage in ("load", "preprocess", "transfer", "step"):
             assert f"pipeline_{stage}_ms" in m_pipe
+        # Padded batch rows on the forced 8-device platform: batch 4 -> 8.
+        rows = 8
         if host_preprocess:
             assert m_pipe["pipeline_preprocess_ms"] > 0
+            # Five float32 views per batch.
+            assert m_pipe["pipeline_transfer_bytes_per_batch"] == (
+                5 * rows * 32 * 32 * 3 * 4
+            )
+        else:
+            # Decode-only worker accounting: raw uint8 pair only, no host
+            # preprocess stage at all — the 10x H2D pin's devpre side.
+            assert m_pipe["pipeline_preprocess_ms"] == 0.0
+            assert m_pipe["pipeline_transfer_bytes_per_batch"] == (
+                2 * rows * 32 * 32 * 3
+            )
 
     a = jax.tree_util.tree_leaves(jax.device_get(sync_eng.state))
     b = jax.tree_util.tree_leaves(jax.device_get(pipe_eng.state))
@@ -349,6 +363,70 @@ def test_transient_decode_fault_in_workers_is_retried(tmp_path, monkeypatch):
     assert faulted_ds.quarantined == []  # retry absorbed it
     for (r0, f0), (r1, f1) in zip(clean, got):
         assert np.array_equal(r0, r1) and np.array_equal(f0, f1)
+
+
+def test_decode_fault_on_raw_uint8_worker_path(tmp_path):
+    """`decode@K` through the FULL device-preprocess training path: the
+    slimmer decode-only workers (raw uint8 ship, no host preprocessing)
+    must still absorb a transient decode failure via _imread_retry — the
+    epoch's metrics and final state are bit-identical to a fault-free run
+    and nothing is quarantined. Regression for the raw-uint8 ingest mode:
+    retry/quarantine must survive `_host_preprocess_np` collapsing to
+    decode+stack."""
+    import jax
+    import pytest as _pytest
+
+    _pytest.importorskip("cv2")
+    from waternet_tpu.data.uieb import UIEBDataset
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    raw, ref = _write_pairs(tmp_path, n=8)
+    cfg = _tiny_config(im_height=16, im_width=16, host_preprocess=False)
+    idx = np.arange(8)
+
+    clean_eng = TrainingEngine(cfg)
+    clean_ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    m_clean = clean_eng.train_epoch_pipelined(clean_ds, idx, epoch=0, workers=2)
+
+    faults.install(faults.FaultPlan.parse("decode@2"))
+    faulted_eng = TrainingEngine(cfg)
+    faulted_ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    m_fault = faulted_eng.train_epoch_pipelined(
+        faulted_ds, idx, epoch=0, workers=2
+    )
+    plan = faults.active()
+    assert ("decode", 2) in plan.fired  # the fault hit a decode-only worker
+    assert faulted_ds.quarantined == []  # retry absorbed it
+
+    for k in m_clean:
+        if k.startswith("pipeline_"):
+            continue  # timings differ by the injected retry, values must not
+        assert m_clean[k] == m_fault[k], (k, m_clean[k], m_fault[k])
+    a = jax.tree_util.tree_leaves(jax.device_get(clean_eng.state))
+    b = jax.tree_util.tree_leaves(jax.device_get(faulted_eng.state))
+    assert all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b)
+    )
+
+
+def test_persistent_decode_fault_quarantines_through_devpre_epoch(tmp_path):
+    """Retry exhaustion on the raw-uint8 path, through the full engine:
+    CorruptPairError escapes train_epoch_pipelined at the consumer's pop,
+    the pair is quarantined, and the pipeline's finally-close joins the
+    decode-only workers (leak guard enforces)."""
+    pytest.importorskip("cv2")
+    from waternet_tpu.data.uieb import CorruptPairError, UIEBDataset
+    from waternet_tpu.training.trainer import TrainingEngine
+
+    faults.install(faults.FaultPlan.parse("decode@1,decode@2,decode@3"))
+    raw, ref = _write_pairs(tmp_path)
+    ds = UIEBDataset(raw, ref, im_height=16, im_width=16)
+    eng = TrainingEngine(
+        _tiny_config(im_height=16, im_width=16, shuffle=False)
+    )
+    with pytest.raises(CorruptPairError, match="0.png"):
+        eng.train_epoch_pipelined(ds, np.arange(4), epoch=0, workers=2)
+    assert ds.quarantined == ["0.png"]
 
 
 def test_persistent_decode_fault_in_workers_quarantines(tmp_path):

@@ -54,7 +54,11 @@ TRAIN_VARIANTS = [
     ("clahe_interp_matmul", {"WATERNET_CLAHE_INTERP": "matmul", **_HOSTFED_ONLY}),
     ("clahe_hist_scatter", {"WATERNET_CLAHE_HIST": "scatter", **_HOSTFED_ONLY}),
     ("clahe_hist_matmul", {"WATERNET_CLAHE_HIST": "matmul", **_HOSTFED_ONLY}),
-    ("pallas_hist", {"WATERNET_PALLAS": "1", **_HOSTFED_ONLY}),
+    # WATERNET_PALLAS=1 selects ALL the fused kernels (tile_lut fused
+    # hist->clip->CDF->LUT + clahe_lut_planes VMEM-local lookups) — since
+    # round 6 this measures the fused kernels, not the histogram kernel
+    # alone; the two hist-only variants above remain the lax baselines.
+    ("pallas_fused", {"WATERNET_PALLAS": "1", **_HOSTFED_ONLY}),
     ("fp32", {"WATERNET_BENCH_PRECISION": "fp32"}),
 ]
 VIDEO_BATCHES = (2, 4, 8)

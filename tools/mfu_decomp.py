@@ -12,7 +12,15 @@ run on the CPU backend — at batch 16/32/64:
 * the same step with ``perceptual_weight=0`` (VGG share by difference);
 * standalone VGG19 forward (splits the VGG share into fwd(out) +
   fwd(ref) + bwd(out));
-* standalone WaterNet forward and SSIM+PSNR metrics.
+* standalone WaterNet forward and SSIM+PSNR metrics;
+* (round 6) the host-fed ``--device-preprocess`` step — raw uint8 in,
+  augment + WB/GC/CLAHE fused in-step — under stage name ``step_devpre``,
+  and the fused preprocess entry (waternet_tpu/ops/fused.py) compiled
+  standalone under ``preprocess_fused_standalone``, so the in-step
+  classical-transform cost is attributed instead of inferred: the
+  ``in_step_preprocess`` share is ``step_devpre - step_full`` (what the
+  raw-ingest step pays over the precached one) next to the standalone
+  stage's own FLOPs/bytes.
 
 Usage::
 
@@ -113,10 +121,33 @@ def main():
                 lambda a, b: (ssim(a, b), psnr(a, b, data_range=1.0))
             ).lower(x, x).compile()
         )
+        # Round-6 stages: the host-fed --device-preprocess step (raw uint8
+        # ingest, in-step fused WB/GC/CLAHE) and the fused preprocess entry
+        # standalone — the in-step classical-transform cost under its own
+        # names instead of buried in a step difference nobody computed.
+        from waternet_tpu.ops.fused import fused_train_preprocess
+
+        raw_u8 = jnp.zeros((batch, hw, hw, 3), jnp.uint8)
+        rng = jax.random.PRNGKey(0)
+        n_real = jnp.asarray(batch, jnp.int32)
+        devpre = _cost(
+            engine.train_step.lower(
+                engine.state, raw_u8, raw_u8, rng, n_real
+            ).compile()
+        )
+        pre_rng = jax.random.PRNGKey(1)  # lowering only; distinct stream
+        pre_fused = _cost(
+            jax.jit(  # jaxlint: disable=R004 one compile per config is the point of the decomposition
+                lambda r, f, k: fused_train_preprocess(r, f, k)
+            ).lower(raw_u8, raw_u8, pre_rng).compile()
+        )
         vgg_total = round(full["gflops"] - no_vgg["gflops"], 3)
+        in_step_pre = round(devpre["gflops"] - full["gflops"], 3)
         row = {
             "step_full": full,
             "step_no_vgg": no_vgg,
+            "step_devpre": devpre,
+            "preprocess_fused_standalone": pre_fused,
             "vgg_fwd_standalone": vgg_fwd,
             "waternet_fwd_standalone": model_fwd,
             "metrics_ssim_psnr": metrics_cost,
@@ -132,6 +163,12 @@ def main():
                 "metrics_share_pct": round(
                     100 * metrics_cost["gflops"] / full["gflops"], 1
                 ),
+                "in_step_preprocess (step_devpre - step_full)": in_step_pre,
+                "preprocess_fused_standalone": pre_fused["gflops"],
+                "in_step_preprocess_share_pct": round(
+                    100 * max(in_step_pre, 0.0) / devpre["gflops"], 1
+                ),
+                "preprocess_mbytes_standalone": pre_fused["mbytes"],
             },
         }
         report["per_batch"][str(batch)] = row

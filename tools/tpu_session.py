@@ -550,6 +550,20 @@ def main():
             steps=args.train_steps, pipeline_ab=True,
         ),
     )
+    # Round-6 re-measure of the host-fed headline under a fresh stage name
+    # (resume skips ok stages; the r5 entry stays as the before side): the
+    # explicit --device-preprocess ingest path — raw uint8 H2D, in-step
+    # fused preprocessing (waternet_tpu/ops/fused.py) — now carrying the
+    # devpre-vs-hostpre A/B fields (images/sec, stall pct, and the
+    # transfer_bytes_per_batch 10x H2D pin) next to the pipeline
+    # instrumentation. docs/MFU.md "Round 6" reads this stage.
+    s.run_stage(
+        "train_bf16_r6_devpre",
+        lambda: bench.measure_train(
+            batch=args.batch, hw=args.hw, precision="bf16", warmup=3,
+            steps=args.train_steps, pipeline_ab=True,
+        ),
+    )
     # The HBM-resident + precached-transforms step (the --device-cache
     # default, and the bench CONTRACT line since round 4): gathers the
     # batch on device and runs ZERO classical transforms in the step.

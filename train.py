@@ -14,6 +14,12 @@ TPU-native additions:
 * ``--vgg-weights`` to point at torchvision VGG19 weights for the perceptual
   loss (auto-converted; falls back to random features with a warning);
 * ``--host-preprocess`` for bit-exact cv2 preprocessing (slow path);
+* ``--device-preprocess`` names the default raw-uint8-ingest mode
+  explicitly: the host feed ships two uint8 tensors per batch (~10x fewer
+  H2D bytes than the host path's five float32 views — pinned by the
+  pipeline's ``transfer_bytes_per_batch`` counter), pipeline workers only
+  hide decode, and augment + WB/GC/CLAHE + scaling run inside the jitted
+  step (waternet_tpu/ops/fused.py);
 * ``--no-shuffle`` restores the reference's unshuffled loader
   (`train.py:234` — a reference defect kept available for bug-compat);
 * ``--resume`` restores params + Adam moments + LR-schedule position from an
@@ -64,7 +70,8 @@ def parse_args(argv=None):
                    "(for resolutions whose activations exceed one chip)")
     p.add_argument("--vgg-weights", type=str, help="VGG19 weights for perceptual loss")
     p.add_argument("--no-perceptual", action="store_true", help="Disable the VGG perceptual term")
-    p.add_argument("--host-preprocess", action="store_true", help="cv2/NumPy WB+GC+CLAHE on host (bit-exact, slow)")
+    p.add_argument("--host-preprocess", action="store_true", help="cv2/NumPy WB+GC+CLAHE on host (bit-exact, slow): the host feed ships five float32 view tensors per batch")
+    p.add_argument("--device-preprocess", action="store_true", help="Explicitly select the DEFAULT training mode: the host feed ships raw uint8 pairs only (two uint8 tensors per batch, ~10x fewer H2D bytes than --host-preprocess; pipeline workers only hide decode) and augment + WB/GC/CLAHE + [0,1] scaling run inside the jitted train step (waternet_tpu/ops/fused.py), as the --device-cache fused step does. Conflicts with --host-preprocess")
     p.add_argument("--workers", type=int, default=2, metavar="N",
                    help="Overlapped input pipeline for the host-fed paths (docs/PIPELINE.md): N worker threads load + preprocess batches ahead of the device step, byte-identical to the synchronous path. 0 disables (synchronous loading); ignored with --device-cache (no per-step host feed to hide)")
     p.add_argument("--prefetch", type=int, default=0, metavar="K",
@@ -102,6 +109,14 @@ def parse_checkpoint_interval(spec):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.device_preprocess and args.host_preprocess:
+        # An explicit contradiction must fail loudly, not silently pick one
+        # (same contract as the ignored-A/B-flag errors below).
+        raise SystemExit(
+            "--device-preprocess and --host-preprocess are mutually "
+            "exclusive (device preprocessing is the default; "
+            "--host-preprocess selects the cv2 host path)"
+        )
     start_ts = time.perf_counter()
     projectroot = Path(__file__).parent
 
@@ -468,6 +483,7 @@ def main(argv=None):
                 "precision": args.precision,
                 "shuffle": config.shuffle,
                 "augment": config.augment,
+                "device_preprocess": config.device_preprocess,
             },
             f,
             indent=4,

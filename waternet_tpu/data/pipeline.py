@@ -22,10 +22,19 @@ fully-convolutional pipelines (Chen et al. 2017; Johnson et al. 2016):
   bounded queue; same ordering/shutdown/error contract for sources that
   cannot be fanned out.
 * :class:`PipelineStats` — per-stage timings (load / preprocess / transfer /
-  step), a queue-depth gauge, and the consumer **stall counter** (pops that
-  had to wait for the batch to be ready). ``stall_pct`` near 0 is the
-  number that proves the overlap on hardware; it surfaces in epoch metrics
-  and in bench.py's host-fed line as ``pipeline_stall_pct``.
+  step), a queue-depth gauge, an H2D **transfer-bytes counter**
+  (``transfer_bytes_per_batch``: two uint8 tensors per batch on the
+  device-preprocess path vs five float32 views on the host-preprocess
+  path — the 10x reduction as a pinned number), and the consumer **stall
+  counter** (pops that had to wait for the batch to be ready).
+  ``stall_pct`` near 0 is the number that proves the overlap on hardware;
+  it surfaces in epoch metrics and in bench.py's host-fed line as
+  ``pipeline_stall_pct``.
+
+In the default `--device-preprocess` mode the worker stage accounting is
+decode-only: ``load`` is pair decode + stack, ``preprocess`` never runs
+(0 timings — augment + WB/GC/CLAHE live inside the jitted step,
+waternet_tpu/ops/fused.py), and ``transfer`` ships the raw uint8 pair.
 
 Both iterators run their threads under the :data:`THREAD_PREFIX` name so
 tests can assert clean shutdown (tests/conftest.py leak guard); ``close()``
@@ -74,11 +83,22 @@ class PipelineStats:
         self._depth_sum = 0
         self.depth_max = 0
         self.workers = 0
+        self._transfer_bytes = 0
+        self._transfer_batches = 0
 
     def add_stage(self, name: str, seconds: float) -> None:
         with self._lock:
             self._stage_s[name] = self._stage_s.get(name, 0.0) + seconds
             self._stage_n[name] = self._stage_n.get(name, 0) + 1
+
+    def add_transfer_bytes(self, nbytes: int) -> None:
+        """Count one batch's H2D payload. The device-preprocess path ships
+        two uint8 tensors (raw, ref); the host-preprocess path ships five
+        float32 views — a 10x byte difference this counter pins as a
+        measured number (``transfer_bytes_per_batch``) instead of prose."""
+        with self._lock:
+            self._transfer_bytes += int(nbytes)
+            self._transfer_batches += 1
 
     @contextmanager
     def stage(self, name: str):
@@ -111,12 +131,20 @@ class PipelineStats:
         with self._lock:
             return self._depth_sum / max(self.pops, 1)
 
+    def transfer_bytes_per_batch(self) -> float:
+        """Mean H2D payload bytes per produced batch (0.0 if untracked)."""
+        with self._lock:
+            return self._transfer_bytes / max(self._transfer_batches, 1)
+
     def metrics(self, prefix: str = "pipeline_") -> dict:
         """Flat float dict for epoch metrics / bench JSON lines."""
         out = {
             f"{prefix}stall_pct": round(self.stall_pct(), 2),
             f"{prefix}queue_depth": round(self.queue_depth_mean(), 2),
             f"{prefix}workers": float(self.workers),
+            f"{prefix}transfer_bytes_per_batch": round(
+                self.transfer_bytes_per_batch(), 1
+            ),
         }
         for name in STAGES:
             out[f"{prefix}{name}_ms"] = round(self.stage_ms(name), 3)

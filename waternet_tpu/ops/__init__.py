@@ -7,12 +7,14 @@ to run fused with the model on TPU).
 
 from waternet_tpu.ops.clahe import clahe, histeq, histeq_np
 from waternet_tpu.ops.color import lab_u8_to_rgb, rgb_to_lab_u8
+from waternet_tpu.ops.fused import fused_train_preprocess
 from waternet_tpu.ops.gamma import gamma_correction, gamma_correction_np
 from waternet_tpu.ops.transform import transform, transform_batch, transform_np
 from waternet_tpu.ops.wb import white_balance, white_balance_np
 
 __all__ = [
     "clahe",
+    "fused_train_preprocess",
     "histeq",
     "histeq_np",
     "lab_u8_to_rgb",

@@ -16,8 +16,10 @@ pipeline given the same L input:
    ``use_pallas``): XLA scatter-add (CPU default; no intermediate),
    one-hot MXU matmul (TPU default; int8 operands by default — see
    ``_onehot_dtypes`` — lax.scan-chunked so the one-hot stays under a
-   64 MB cap at any frame size), or the Pallas VPU comparison-reduction
-   kernel.
+   64 MB cap at any frame size), or the Pallas comparison-reduction
+   kernel — which, in the pallas mode, FUSES steps 2-5 into one kernel
+   (``pallas_kernels.tile_lut``: histogram, clip, redistribution, CDF and
+   LUT never leave VMEM; bit-identical to the lax pipeline).
 3. Integer clip limit ``max(int(clipLimit * tileArea / 256), 1)`` — note with
    the reference's clipLimit=0.1 this is the minimum value 1, i.e. maximal
    clipping: the equalization mostly rank-equalizes the *distinct* gray
@@ -27,7 +29,11 @@ pipeline given the same L input:
    ``k < r`` (vectorized form of OpenCV's residual loop).
 5. LUT = round(cdf * 255 / tileArea) (round-half-to-even, as cvRound).
 6. Per-pixel bilinear interpolation between the 4 surrounding tile LUTs with
-   OpenCV's ``(x / tile_w) - 0.5`` tile coordinates and edge clamping.
+   OpenCV's ``(x / tile_w) - 0.5`` tile coordinates and edge clamping —
+   three strategies (``WATERNET_CLAHE_INTERP`` / ``use_pallas``): gather
+   (CPU default), batched one-hot MXU matmul over the cell decomposition
+   (TPU default), or the fused Pallas lookup+blend kernel
+   (``pallas_kernels.clahe_lut_planes``), all bit-identical.
 
 The L channel fed to CLAHE is bit-exact vs cv2 too (the forward LAB
 conversion replicates OpenCV's uint8 fixed-point pipeline — see
@@ -126,22 +132,39 @@ def _onehot_dtypes():
     )
 
 
-def _interp_mode(th: int, tw: int) -> str:
-    """Resolve the LUT-interpolation strategy: 'gather' or 'matmul'.
+def _interp_mode(th: int, tw: int, use_pallas=None) -> str:
+    """Resolve the LUT-interpolation strategy: 'gather', 'matmul', or
+    'pallas'.
 
-    ``WATERNET_CLAHE_INTERP`` forces a mode (matmul still falls back per
-    shape when the cell decomposition is impossible — see clahe()). Auto
-    picks the one-hot matmul on TPU (gathers serialize on TPU; a one-hot
-    bf16 matmul rides the MXU). Memory is bounded either way: the matmul
-    chunks itself under the env-tunable :func:`_matmul_cap_bytes` cap
-    (default ``_MATMUL_ONEHOT_CAP_BYTES``), and odd tile sizes
-    degrade the cells to single rows/columns (more, smaller matmuls) —
-    still MXU-shaped, so auto enables them too; `tools/ab_bench.py`
-    measures whether that holds up against gather per config.
+    An explicit ``use_pallas`` wins (as in :func:`_hist_mode`): True
+    selects the fused Pallas lookup+blend kernel
+    (:func:`waternet_tpu.ops.pallas_kernels.clahe_lut_planes`), False the
+    non-Pallas auto choice. ``WATERNET_CLAHE_INTERP`` forces any mode
+    (matmul still falls back per shape when the cell decomposition is
+    impossible — see clahe()); ``pallas_enabled()`` (WATERNET_PALLAS=1)
+    selects the kernel; otherwise auto picks the one-hot matmul on TPU
+    (gathers serialize on TPU; a one-hot matmul rides the MXU). Memory is
+    bounded every way: the matmul chunks itself under the env-tunable
+    :func:`_matmul_cap_bytes` cap (default ``_MATMUL_ONEHOT_CAP_BYTES``),
+    the Pallas kernel subdivides its cell blocks under
+    ``_PALLAS_INTERP_BLOCK_CAP``, and odd tile sizes degrade the cells to
+    single rows/columns (more, smaller blocks) — still MXU-shaped, so
+    auto enables them too; `tools/ab_bench.py` measures whether that
+    holds up against gather per config.
     """
+    if use_pallas is not None:
+        if use_pallas:
+            return "pallas"
+        from waternet_tpu.utils.platform import is_tpu_backend
+
+        return "matmul" if is_tpu_backend() else "gather"
     forced = os.environ.get("WATERNET_CLAHE_INTERP", "").strip().lower()
-    if forced in ("gather", "matmul"):
+    if forced in ("gather", "matmul", "pallas"):
         return forced
+    from waternet_tpu.ops.pallas_kernels import pallas_enabled
+
+    if pallas_enabled():
+        return "pallas"
     from waternet_tpu.utils.platform import is_tpu_backend
 
     return "matmul" if is_tpu_backend() else "gather"
@@ -150,12 +173,15 @@ def _interp_mode(th: int, tw: int) -> str:
 def _hist_mode(use_pallas) -> str:
     """Resolve the histogram strategy: 'scatter', 'matmul', or 'pallas'.
 
-    ``use_pallas=True`` (or ``WATERNET_PALLAS=1``) selects the Pallas VPU
-    comparison-reduction kernel. ``WATERNET_CLAHE_HIST`` forces any mode.
-    Auto prefers the one-hot MXU matmul on TPU (bincount lowers to a
-    serialized scatter-add there); the matmul chunks itself under the 64 MB
-    one-hot cap, so it handles any frame size. CPU keeps scatter (fast
-    there).
+    ``use_pallas=True`` (or ``WATERNET_PALLAS=1``) selects the Pallas
+    path — which, inside :func:`clahe`, is the FUSED ``tile_lut`` kernel
+    (histogram + clip + CDF + LUT in one; the standalone
+    ``tile_histogram`` kernel remains the pallas branch of
+    :func:`_tile_hist` for histogram-only callers).
+    ``WATERNET_CLAHE_HIST`` forces any mode. Auto prefers the one-hot MXU
+    matmul on TPU (bincount lowers to a serialized scatter-add there);
+    the matmul chunks itself under the 64 MB one-hot cap, so it handles
+    any frame size. CPU keeps scatter (fast there).
     """
     # Explicit argument wins over the env override (an exported
     # WATERNET_CLAHE_HIST must not silently reroute callers — or tests —
@@ -181,7 +207,11 @@ def _tile_hist(tiles, use_pallas):
     n_tiles, tile_area = tiles.shape
     mode = _hist_mode(use_pallas)
     if mode == "pallas":
-        # Dense VPU comparison-reduction kernel (scatter-free).
+        # Dense VPU comparison-reduction kernel (scatter-free). clahe()
+        # itself never reaches this branch in pallas mode — it routes to
+        # the fused tile_lut kernel before computing a bare histogram —
+        # so this serves histogram-only callers (and the kernel's own
+        # parity tests).
         from waternet_tpu.ops.pallas_kernels import tile_histogram
 
         return tile_histogram(tiles)
@@ -230,6 +260,58 @@ def _tile_hist(tiles, use_pallas):
     tile_ids = jnp.repeat(jnp.arange(n_tiles, dtype=jnp.int32), tile_area)
     flat_idx = tile_ids * 256 + tiles.reshape(-1)
     return jnp.bincount(flat_idx, length=n_tiles * 256).reshape(n_tiles, 256)
+
+
+def _luts_from_hist(hist, clip, lut_scale) -> jnp.ndarray:
+    """(T, 256) integer histograms -> (T, 256) float32 LUTs: OpenCV's
+    integer clip + excess redistribution, then LUT = round(cdf * scale)
+    with the single-rounded float32 ``lut_scale``. This is the ONE
+    reference for that arithmetic: the lax CLAHE path calls it with
+    static Python ``clip``/numpy ``lut_scale``, the serving-side masked
+    variant (ops/masked.py) with traced scalars (every op broadcasts),
+    and the fused Pallas kernel
+    (:func:`waternet_tpu.ops.pallas_kernels.tile_lut`) must match it
+    bit-for-bit (pinned in tests/test_pallas.py)."""
+    excess = jnp.sum(jnp.maximum(hist - clip, 0), axis=-1)  # (T,)
+    hist = jnp.minimum(hist, clip)
+    hist = hist + (excess // 256)[:, None]
+    residual = excess % 256  # always < 256
+    step = jnp.maximum(256 // jnp.maximum(residual, 1), 1)  # (T,)
+    bins = jnp.arange(256, dtype=jnp.int32)
+    inc = (
+        (residual[:, None] > 0)
+        & (bins[None, :] % step[:, None] == 0)
+        & (bins[None, :] // step[:, None] < residual[:, None])
+    )
+    hist = hist + inc.astype(jnp.int32)
+    cdf = jnp.cumsum(hist, axis=-1).astype(jnp.float32)
+    return jnp.clip(jnp.round(cdf * lut_scale), 0.0, 255.0)
+
+
+# Per-block one-hot cap for the fused Pallas interpolation kernel: a cell
+# block materializes a (cell_h * cell_w, 256) f32 compare matrix in VMEM,
+# so giant even tiles (full-res frames) subdivide their cells to fit.
+_PALLAS_INTERP_BLOCK_CAP = 4 * 1024 * 1024
+
+
+def _shrink_cell(cell, cells, unit_bytes, cap=None):
+    """Subdivide one cell extent until ``cell * unit_bytes`` fits the cap.
+
+    Any divisor keeps per-cell tile-pair constancy (entries repeat), the
+    same argument as :func:`_fit_cell_rows`. Returns the adjusted
+    (cell, cells); a 1-pixel extent always "fits" (the cap bounds the
+    per-block one-hot, whose other factor the caller passes in). ``cap``
+    resolves late so tests can shrink ``_PALLAS_INTERP_BLOCK_CAP`` and
+    pin that subdivision never changes bits."""
+    if cap is None:
+        cap = _PALLAS_INTERP_BLOCK_CAP
+    d = cell
+    while d > 1 and d * unit_bytes > cap:
+        d = max(k for k in range(1, d) if d % k == 0)
+    if d != cell:
+        lo, hi = cells
+        cells = (np.repeat(lo, cell // d), np.repeat(hi, cell // d))
+    return d, cells
 
 
 def _cell_tile_indices(n_pix, tile, n_tiles):
@@ -404,34 +486,24 @@ def clahe(
     n_tiles = ty * tx
     tile_area = th * tw
 
-    # --- per-tile histograms ---
-    tiles = x.reshape(ty, th, tx, tw).transpose(0, 2, 1, 3).reshape(n_tiles, tile_area)
-    hist = _tile_hist(tiles, use_pallas)
-
-    # --- clip + redistribute (OpenCV integer semantics) ---
-    clip = max(int(clip_limit * tile_area / 256.0), 1)
-    excess = jnp.sum(jnp.maximum(hist - clip, 0), axis=-1)  # (T,)
-    hist = jnp.minimum(hist, clip)
-    hist = hist + (excess // 256)[:, None]
-    residual = excess % 256  # always < 256
-    step = jnp.maximum(256 // jnp.maximum(residual, 1), 1)  # (T,)
-    bins = jnp.arange(256, dtype=jnp.int32)
-    inc = (
-        (residual[:, None] > 0)
-        & (bins[None, :] % step[:, None] == 0)
-        & (bins[None, :] // step[:, None] < residual[:, None])
-    )
-    hist = hist + inc.astype(jnp.int32)
-
-    # --- LUTs: rounded scaled CDF ---
-    # Single-rounded float32 division, exactly OpenCV's
+    # --- per-tile histograms -> clip/redistribute -> LUTs ---
+    # clip: OpenCV's integer clip limit. lut_scale: single-rounded float32
+    # division, exactly OpenCV's
     # ``const float lutScale = static_cast<float>(histSize - 1) / tileSizeTotal``
     # (a Python-float 255.0/area would double-round through float64 — and
     # would not be reproducible by the serving path's dynamic-shape variant,
     # ops/masked.py, which must divide in f32 on device).
+    tiles = x.reshape(ty, th, tx, tw).transpose(0, 2, 1, 3).reshape(n_tiles, tile_area)
+    clip = max(int(clip_limit * tile_area / 256.0), 1)
     lut_scale = np.float32(255.0) / np.float32(tile_area)
-    cdf = jnp.cumsum(hist, axis=-1).astype(jnp.float32)
-    luts = jnp.clip(jnp.round(cdf * lut_scale), 0.0, 255.0)  # (T, 256)
+    if _hist_mode(use_pallas) == "pallas":
+        # Fused kernel: histogram + clip + redistribute + CDF + LUT never
+        # leave VMEM (bit-identical to the lax pipeline below).
+        from waternet_tpu.ops.pallas_kernels import tile_lut
+
+        luts = tile_lut(tiles, clip, lut_scale)
+    else:
+        luts = _luts_from_hist(_tile_hist(tiles, use_pallas), clip, lut_scale)
     luts = luts.reshape(ty, tx, 256)
 
     # --- bilinear interpolation between tile LUTs ---
@@ -441,7 +513,7 @@ def clahe(
     # OpenCV computes tile coords as x * (1/tile_size) with a float32
     # reciprocal (not a division); matching that exactly is what makes the
     # rounding ties land identically (verified bit-exact vs cv2).
-    mode = _interp_mode(th, tw)
+    mode = _interp_mode(th, tw, use_pallas)
     if mode == "matmul":
         cell_h, cells_y = _cell_tile_indices(hp, th, ty)
         cell_w, cells_x = _cell_tile_indices(wp, tw, tx)
@@ -450,6 +522,13 @@ def clahe(
             mode = "gather"  # even 1-px cell rows can't fit the cap
         else:
             cell_h, cells_y = fitted
+    elif mode == "pallas":
+        # Cell decomposition for the fused kernel; giant even tiles
+        # subdivide so each block's (pixels, 256) one-hot fits VMEM.
+        cell_h, cells_y = _cell_tile_indices(hp, th, ty)
+        cell_w, cells_x = _cell_tile_indices(wp, tw, tx)
+        cell_h, cells_y = _shrink_cell(cell_h, cells_y, cell_w * 256 * 4)
+        cell_w, cells_x = _shrink_cell(cell_w, cells_x, cell_h * 256 * 4)
     gh, gw = (h, w) if mode == "gather" else (hp, wp)
     inv_th = np.float32(1.0) / np.float32(th)
     inv_tw = np.float32(1.0) / np.float32(tw)
@@ -460,7 +539,22 @@ def clahe(
     ya = (yy - y1.astype(jnp.float32))[:, None]
     xa = (xx - x1.astype(jnp.float32))[None, :]
 
-    if mode == "matmul":
+    if mode == "pallas":
+        # All four lookups in ONE fused kernel over the cell decomposition
+        # (bit-identical plane values; the blend stays out here where its
+        # fma contraction matches the other strategies — see
+        # pallas_kernels.clahe_lut_planes), computed on the padded grid
+        # and cropped after the blend.
+        from waternet_tpu.ops.pallas_kernels import clahe_lut_planes
+
+        p11, p12, p21, p22 = clahe_lut_planes(
+            luts, x, cells_y, cells_x, cell_h, cell_w
+        )
+        res = (p11 * (1.0 - xa) + p12 * xa) * (1.0 - ya) + (
+            p21 * (1.0 - xa) + p22 * xa
+        ) * ya
+        res = res[:h, :w]
+    elif mode == "matmul":
         # All four lookups as batched MXU one-hot matmuls over the cell
         # decomposition (bit-identical values; see _lut_planes_matmul),
         # computed on the padded grid and cropped after the blend.

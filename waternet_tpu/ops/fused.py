@@ -1,0 +1,66 @@
+"""Step-shaped fused preprocessing: the device-preprocess training entry.
+
+The `--device-preprocess` training mode (the default) ships RAW uint8
+pairs to the device — the PR-2 pipeline workers only decode and stack —
+and runs everything else inside the jitted train step: paired dihedral
+augmentation, the classical WB/GC/CLAHE views, and the [0, 1] scaling the
+network consumes. This module is that in-step stage as a standalone,
+jittable entry point, factored out of ``TrainingEngine._preprocess`` so
+
+* the trainer, ``bench.py``'s isolated-preprocess timing, and
+  ``tools/mfu_decomp.py``'s FLOP attribution all compile the SAME
+  program — the decomposition can never describe a different stage than
+  the step runs;
+* the stage has one home in the ops layer (L1), next to the transforms
+  it fuses, instead of living as a trainer method.
+
+Exactness: identical ops in identical order to the historical trainer
+inline code (augment_pair_batch then transform_batch then the five
+``/255`` scalings), so factoring it out changes no bits — pinned by the
+device-preprocess parity tests (tests/test_device_preprocess.py).
+
+The CLAHE stage inside :func:`waternet_tpu.ops.transform.transform_batch`
+is where the step's classical-transform time goes (BENCH_r05 measured the
+in-step transforms at ~22 ms of the 47.8 ms step at 112²/batch-16); its
+Pallas-fused hot spots live in :mod:`waternet_tpu.ops.pallas_kernels` and
+are selected through the normal ``ops.clahe`` strategy resolution
+(``WATERNET_PALLAS=1`` / ``pallas_enabled()``), so this entry needs no
+kernel knowledge of its own.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from waternet_tpu.data.augment import augment_pair_batch
+from waternet_tpu.ops.transform import transform_batch
+
+
+def fused_train_preprocess(
+    raw_u8: jnp.ndarray,
+    ref_u8: jnp.ndarray,
+    rng: Optional[jnp.ndarray],
+    *,
+    augment: bool = True,
+) -> Tuple[jnp.ndarray, ...]:
+    """uint8 (raw, ref) batch -> the five [0, 1] float32 training views.
+
+    Args:
+        raw_u8: (N, H, W, 3) uint8(-valued) raw batch.
+        ref_u8: (N, H, W, 3) uint8(-valued) reference batch.
+        rng: augmentation PRNG key, or None (eval: no augmentation even
+            when ``augment`` is True — mirrors the trainer contract).
+        augment: apply the paired dihedral augmentation.
+
+    Returns:
+        ``(x, wbn, hen, gcn, refn)`` float32 batches scaled to [0, 1], in
+        the network's input order.
+    """
+    raw = raw_u8.astype(jnp.float32)
+    ref = ref_u8.astype(jnp.float32)
+    if augment and rng is not None:
+        raw, ref = augment_pair_batch(rng, raw, ref)
+    wb, gc, he = transform_batch(raw)
+    return raw / 255.0, wb / 255.0, he / 255.0, gc / 255.0, ref / 255.0

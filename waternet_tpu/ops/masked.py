@@ -60,7 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from waternet_tpu.ops.clahe import CLIP_LIMIT, TILE_GRID
+from waternet_tpu.ops.clahe import CLIP_LIMIT, TILE_GRID, _luts_from_hist
 from waternet_tpu.ops.color import lab_u8_to_rgb, rgb_to_lab_u8
 from waternet_tpu.ops.gamma import gamma_correction
 from waternet_tpu.ops.wb import _SAT
@@ -179,33 +179,18 @@ def clahe_masked(l_canvas: jnp.ndarray, h, w) -> jnp.ndarray:
         .reshape(n_tiles, 256)
     )
 
-    # --- clip + redistribute (OpenCV integer semantics) ---
+    # --- clip + redistribute + LUTs: the native path's shared reference
+    # (clahe._luts_from_hist), with DYNAMIC clip/scale scalars ---
     # clip = max(int(0.1 * area / 256), 1) == max(area // 2560, 1): the f64
     # literal 0.1 is 0.1*(1+5.6e-17), an upward error far too small to push
     # int() past an integer boundary for any integer area, so the native
     # path's trace-time Python formula and this integer division agree for
-    # every tile size.
+    # every tile size. lut_scale is the same single-rounded f32 division as
+    # OpenCV and the native path.
     denom = int(round(256.0 / CLIP_LIMIT))
     clip = jnp.maximum(tile_area // denom, 1)
-    excess = jnp.sum(jnp.maximum(hist - clip, 0), axis=-1)
-    hist = jnp.minimum(hist, clip)
-    hist = hist + (excess // 256)[:, None]
-    residual = excess % 256
-    step = jnp.maximum(256 // jnp.maximum(residual, 1), 1)
-    bins = jnp.arange(256, dtype=jnp.int32)
-    inc = (
-        (residual[:, None] > 0)
-        & (bins[None, :] % step[:, None] == 0)
-        & (bins[None, :] // step[:, None] < residual[:, None])
-    )
-    hist = hist + inc.astype(jnp.int32)
-
-    # --- LUTs: rounded scaled CDF (single-rounded f32 scale, as OpenCV
-    # and the native path) ---
     lut_scale = jnp.float32(255.0) / tile_area.astype(jnp.float32)
-    cdf2 = jnp.cumsum(hist, axis=-1).astype(jnp.float32)
-    luts = jnp.clip(jnp.round(cdf2 * lut_scale), 0.0, 255.0)
-    luts = luts.reshape(ty, tx, 256)
+    luts = _luts_from_hist(hist, clip, lut_scale).reshape(ty, tx, 256)
 
     # --- bilinear interpolation between tile LUTs (gather formulation,
     # identical f32 reciprocal/coordinate arithmetic as the native path,

@@ -4,18 +4,42 @@ The only model FLOPs live in convolutions, which XLA already schedules onto
 the MXU optimally — hand-writing conv kernels would be a regression. What
 XLA does *not* do well on TPU is the scatter-add at the heart of CLAHE's
 per-tile histograms (`waternet_tpu.ops.clahe` uses ``jnp.bincount``, which
-lowers to a serialized scatter). This module replaces it with a
-comparison-matrix reduction that maps onto the VPU:
+lowers to a serialized scatter) and the HBM byte stream of the one-hot
+LUT-interpolation matmul (~1 GB/frame at 1080p — the round-5 hog,
+docs/CLAHE_1080.md). Three kernels:
 
-    hist[t, b] = sum_over_pixels( tile[t, :] == b )
+* :func:`tile_histogram` — per-tile histograms as a comparison-matrix
+  reduction on the VPU::
 
-computed as a (chunk, 256) bool matrix sum per grid step — dense, regular,
-8x128-lane friendly — accumulated across pixel chunks so arbitrarily large
-tiles (1080p frames: 32k+ pixels/tile) never exceed VMEM.
+      hist[t, b] = sum_over_pixels( tile[t, :] == b )
+
+  computed as a (chunk, 256) bool matrix sum per grid step — dense,
+  regular, 8x128-lane friendly — accumulated across pixel chunks so
+  arbitrarily large tiles (1080p frames: 32k+ pixels/tile) never exceed
+  VMEM.
+* :func:`tile_lut` — the same histogram accumulation FUSED with OpenCV's
+  integer clip/redistribute and the rounded scaled CDF, emitting the
+  per-tile LUTs directly: the histogram never round-trips HBM between
+  the three stages. Bit-identical to the lax pipeline
+  (``clahe._tile_hist`` + ``clahe._luts_from_hist``) — same integer ops,
+  same single-rounded float32 LUT scale.
+* :func:`clahe_lut_planes` — all four quadrant LUT lookups in one kernel
+  over the cell decomposition (every pixel of a cell interpolates
+  between the same four tile LUTs): the one-hot compare matrix lives
+  only in VMEM, per (cell-sized) block, instead of streaming a
+  (pixels, 256) operand through HBM per quadrant as the XLA matmul path
+  must. Lookups are exact (each f32 dot term is one ``1 * value``
+  product plus exact zeros); the cheap bilinear blend deliberately stays
+  in the caller's XLA program, where its fma contraction matches the lax
+  strategies — measured: an in-kernel blend contracts differently and
+  flips round() ties by 1 level on ~3e-4 of pixels. Result: bit-identical
+  to both lax interpolation strategies.
 
 Enabled via ``WATERNET_PALLAS=1`` (or ``use_pallas=True`` arguments); the
-default stays the XLA path until the kernel is profiled on real hardware.
-Tests run the kernel in interpreter mode on CPU for exactness.
+default stays the XLA path until the kernels are profiled on real
+hardware. Tests run every kernel in interpreter mode on CPU for
+exactness (tests/test_pallas.py), including odd tile grids where the
+cell decomposition degrades to single rows/columns.
 """
 
 from __future__ import annotations
@@ -82,7 +106,205 @@ def tile_histogram(tiles: jnp.ndarray, interpret: bool | None = None) -> jnp.nda
     selected automatically.
     """
     if interpret is None:
-        from waternet_tpu.utils.platform import is_tpu_backend
-
-        interpret = not is_tpu_backend()
+        interpret = _auto_interpret()
     return _tile_histogram_impl(tiles, interpret)
+
+
+def _auto_interpret() -> bool:
+    """Interpreter mode everywhere but a real TPU backend (incl. tunnelled
+    plugins registering under another platform name)."""
+    from waternet_tpu.utils.platform import is_tpu_backend
+
+    return not is_tpu_backend()
+
+
+# ---------------------------------------------------------------------------
+# Fused histogram -> clip -> redistribute -> CDF -> LUT
+# ---------------------------------------------------------------------------
+
+
+def _lut_kernel(vals_ref, hist_ref, lut_ref, *, clip, scale, n_chunks):
+    """Grid: (n_tiles, n_chunks). Accumulates one tile's histogram across
+    its pixel chunks; the LAST chunk applies OpenCV's integer clip +
+    excess redistribution and emits LUT = round(cdf * scale) in place —
+    the exact per-tile arithmetic of ``clahe._luts_from_hist``."""
+    step = pl.program_id(1)
+
+    @pl.when(step == 0)
+    def _():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+        lut_ref[:] = jnp.zeros_like(lut_ref)
+
+    vals = vals_ref[:]  # (1, CHUNK) int32, padded with -1 beyond the tile
+    bins = jax.lax.broadcasted_iota(jnp.int32, (_CHUNK, _BINS), 1)
+    onehot = (vals.reshape(_CHUNK, 1) == bins).astype(jnp.int32)
+    hist_ref[:] = hist_ref[:] + jnp.sum(onehot, axis=0, keepdims=True)
+
+    @pl.when(step == n_chunks - 1)
+    def _():
+        hist = hist_ref[:]  # (1, 256) accumulated counts
+        excess = jnp.sum(jnp.maximum(hist - clip, 0))
+        clipped = jnp.minimum(hist, clip) + excess // 256
+        residual = excess % 256  # scalar < 256
+        stride = jnp.maximum(256 // jnp.maximum(residual, 1), 1)
+        b = jax.lax.broadcasted_iota(jnp.int32, (1, _BINS), 1)
+        inc = (
+            (residual > 0)
+            & (b % stride == 0)
+            & (b // stride < residual)
+        )
+        cdf = jnp.cumsum(
+            clipped + inc.astype(jnp.int32), axis=-1
+        ).astype(jnp.float32)
+        lut_ref[:] = jnp.clip(jnp.round(cdf * scale), 0.0, 255.0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("clip", "scale", "interpret")
+)
+def _tile_lut_impl(tiles, *, clip, scale, interpret):
+    t, area = tiles.shape
+    n_chunks = -(-area // _CHUNK)
+    pad = n_chunks * _CHUNK - area
+    vals = tiles.astype(jnp.int32)
+    if pad:
+        vals = jnp.pad(vals, ((0, 0), (0, pad)), constant_values=-1)
+
+    _, luts = pl.pallas_call(
+        functools.partial(
+            _lut_kernel, clip=clip, scale=scale, n_chunks=n_chunks
+        ),
+        grid=(t, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, _CHUNK), lambda i, j: (i, j)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, _BINS), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, _BINS), lambda i, j: (i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, _BINS), jnp.int32),
+            jax.ShapeDtypeStruct((t, _BINS), jnp.float32),
+        ),
+        interpret=interpret,
+    )(vals)
+    return luts
+
+
+def tile_lut(
+    tiles: jnp.ndarray,
+    clip: int,
+    lut_scale,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """(T, A) uint8-valued tiles -> (T, 256) float32 CLAHE LUTs, fused.
+
+    One kernel runs histogram accumulation, OpenCV's integer clip limit +
+    excess redistribution, the CDF, and the rounded scaled LUT — the
+    histogram stays in VMEM across all four stages. ``clip`` is the
+    static integer clip limit (``max(int(clip_limit * area / 256), 1)``),
+    ``lut_scale`` the single-rounded float32 ``255 / area``. Bit-identical
+    to the lax pipeline for any tile count/area (pinned in
+    tests/test_pallas.py across odd grids).
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    return _tile_lut_impl(
+        tiles, clip=int(clip), scale=float(lut_scale), interpret=interpret
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused four-quadrant LUT lookup + bilinear blend
+# ---------------------------------------------------------------------------
+
+
+def _interp_kernel(lut_ref, v_ref, out_ref):
+    """Grid: (n_cells_y, n_cells_x). One cell: every pixel shares the same
+    four tile LUTs, so all four quadrant lookups are ONE VMEM-local
+    one-hot matmul against a (256, 4) table — each output element is a
+    single exact ``1 * value`` product plus exact zeros, so the planes
+    are bit-identical to gathers."""
+    four, ch, cw = out_ref.shape
+    pix = ch * cw
+    v = v_ref[:].reshape(pix, 1)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (pix, _BINS), 1)
+    onehot = (v == bins).astype(jnp.float32)
+    tables = lut_ref[0, 0]  # (256, 4): quadrants 11, 12, 21, 22
+    looked = jax.lax.dot_general(
+        onehot,
+        tables,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (pix, 4)
+    out_ref[:] = looked.T.reshape(4, ch, cw)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cell_h", "cell_w", "interpret")
+)
+def _lut_interp_impl(cell_luts, v_pad, *, cell_h, cell_w, interpret):
+    hp, wp = v_pad.shape
+    ncy, ncx = hp // cell_h, wp // cell_w
+    return pl.pallas_call(
+        _interp_kernel,
+        grid=(ncy, ncx),
+        in_specs=[
+            pl.BlockSpec((1, 1, _BINS, 4), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((cell_h, cell_w), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((4, cell_h, cell_w), lambda i, j: (0, i, j)),
+        out_shape=jax.ShapeDtypeStruct((4, hp, wp), jnp.float32),
+        interpret=interpret,
+    )(cell_luts, v_pad.astype(jnp.int32))
+
+
+def clahe_lut_planes(
+    luts: jnp.ndarray,
+    v_pad: jnp.ndarray,
+    cells_y,
+    cells_x,
+    cell_h: int,
+    cell_w: int,
+    interpret: bool | None = None,
+):
+    """Fused four-quadrant CLAHE LUT lookup over the cell decomposition.
+
+    Args:
+        luts: (ty, tx, 256) float32 per-tile LUTs.
+        v_pad: (hp, wp) integer-valued L channel on the padded grid;
+            ``hp % cell_h == 0`` and ``wp % cell_w == 0`` (the cell
+            decomposition partitions the padded grid by construction).
+        cells_y / cells_x: ``(lo, hi)`` per-cell tile indices along each
+            axis (from ``clahe._cell_tile_indices``, possibly subdivided).
+    Returns:
+        Four (hp, wp) float32 planes (quadrants 11, 12, 21, 22) holding
+        exact LUT values — bit-identical to the gather/matmul lookups.
+
+    The bilinear blend deliberately stays OUTSIDE the kernel, in the
+    caller's XLA program: the blend is 1-ulp sensitive to fma contraction
+    (documented in docs/SERVING.md for the serving variant), and a
+    separately-compiled kernel program contracts it differently than the
+    lax paths — moving only the lookups (the actual HBM byte-stream hog:
+    a (pixels, 256) one-hot operand per quadrant in the XLA matmul
+    formulation) into VMEM-local blocks keeps the whole CLAHE output
+    bit-identical across all three interpolation strategies. The
+    (cells, 256, 4) quadrant table is gathered outside the kernel — tiny
+    (4 KB per cell) next to the per-pixel one-hot stream.
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    y1, y2 = (jnp.asarray(c) for c in cells_y)
+    x1, x2 = (jnp.asarray(c) for c in cells_x)
+
+    def tab(yi, xi):  # (ncy, ncx, 256)
+        return luts[yi[:, None], xi[None, :], :]
+
+    cell_luts = jnp.stack(
+        [tab(y1, x1), tab(y1, x2), tab(y2, x1), tab(y2, x2)], axis=-1
+    )  # (ncy, ncx, 256, 4) — quadrant order matches the kernel unpack
+    planes = _lut_interp_impl(
+        cell_luts, v_pad,
+        cell_h=int(cell_h), cell_w=int(cell_w), interpret=interpret,
+    )
+    return planes[0], planes[1], planes[2], planes[3]

@@ -34,7 +34,6 @@ from flax import struct
 
 from waternet_tpu.data.augment import (
     apply_augment_batch,
-    augment_pair_batch,
     dihedral_apply,
     dihedral_variant_count,
     dihedral_variant_index,
@@ -42,7 +41,7 @@ from waternet_tpu.data.augment import (
 )
 from waternet_tpu.models import WaterNet
 from waternet_tpu.models.vgg import VGG19Features
-from waternet_tpu.ops import transform_batch
+from waternet_tpu.ops.fused import fused_train_preprocess
 from waternet_tpu.parallel.mesh import (
     DATA_AXIS,
     SPATIAL_AXIS,
@@ -127,7 +126,13 @@ class TrainConfig:
     seed: int = 0
     augment: bool = True
     # Host preprocessing (cv2/NumPy WB+GC+CLAHE per item, reference-bit-exact
-    # but serialized on host CPU). Default off: device preprocessing.
+    # but serialized on host CPU). Default off: device preprocessing — the
+    # `--device-preprocess` training mode, where the host feed ships RAW
+    # uint8 pairs only (two uint8 tensors per batch, ~10x fewer H2D bytes
+    # than the five float32 views), pipeline workers only hide decode, and
+    # augment + WB/GC/CLAHE + scaling run inside the jitted step
+    # (ops/fused.py). Parity between the two modes is pinned in
+    # tests/test_device_preprocess.py.
     host_preprocess: bool = False
     # Spatial (H-axis) sharding of the training images over the mesh's
     # spatial axis, for very-high-resolution training where one chip can't
@@ -161,6 +166,13 @@ class TrainConfig:
     @property
     def dtype(self):
         return jnp.bfloat16 if self.precision == "bf16" else jnp.float32
+
+    @property
+    def device_preprocess(self) -> bool:
+        """The raw-uint8-ingest training mode (the default): the inverse
+        of ``host_preprocess``, named for the `--device-preprocess` CLI
+        flag and the bench A/B."""
+        return not self.host_preprocess
 
 
 @struct.dataclass
@@ -234,13 +246,17 @@ class TrainingEngine:
     # ------------------------------------------------------------------
 
     def _preprocess(self, raw_u8, ref_u8, rng):
-        """Device-side: (optional) augment + WB/GC/CLAHE + scaling."""
-        raw = raw_u8.astype(jnp.float32)
-        ref = ref_u8.astype(jnp.float32)
-        if self.config.augment and rng is not None:
-            raw, ref = augment_pair_batch(rng, raw, ref)
-        wb, gc, he = transform_batch(raw)
-        return raw / 255.0, wb / 255.0, he / 255.0, gc / 255.0, ref / 255.0
+        """Device-side: (optional) augment + WB/GC/CLAHE + scaling.
+
+        Delegates to the step-shaped ops entry
+        (:func:`waternet_tpu.ops.fused.fused_train_preprocess`) so the
+        trainer, bench's isolated-preprocess timing, and
+        ``tools/mfu_decomp.py``'s FLOP attribution all compile the same
+        program.
+        """
+        return fused_train_preprocess(
+            raw_u8, ref_u8, rng, augment=self.config.augment
+        )
 
     def _unshard_spatial(self, t):
         """Reshard an NHWC batch to batch-only sharding (H gathered).
@@ -1293,11 +1309,15 @@ class TrainingEngine:
                 t0 = _time.perf_counter()
                 payload["tensors"] = tuple(self._to_global(a) for a in arrs)
                 stats.add_stage("transfer", _time.perf_counter() - t0)
+                # Five float32 views per batch: the H2D payload the
+                # device-preprocess path shrinks 10x (two uint8 tensors).
+                stats.add_transfer_bytes(sum(a.nbytes for a in arrs))
                 return count, payload
             t0 = _time.perf_counter()
             payload["raw_g"] = self._to_global(raw_p)
             payload["ref_g"] = self._to_global(ref_p)
             stats.add_stage("transfer", _time.perf_counter() - t0)
+            stats.add_transfer_bytes(raw_p.nbytes + ref_p.nbytes)
             return count, payload
 
         return produce
