@@ -67,6 +67,16 @@ throughput over real sockets, the unloaded and loaded p99, and the
 admission watermark — with total request accounting (``accounted``)
 pinning that nothing is silently dropped.
 
+``--config tiers`` measures the per-request quality-tier A/B
+(docs/SERVING.md "Quality tiers"): one tier-routing batcher serves the
+same mixed-resolution stream through the full WaterNet pipeline and then
+through the distilled CAN student (``fast_tier_images_per_sec``), plus
+the int8 student through the identical bucketed machinery — reporting
+the teacher-vs-student throughput A/B, the analytic FLOP ratio,
+SSIM-vs-teacher over the stream, and the int8-vs-float student error.
+Point WATERNET_STUDENT_WEIGHTS at a distilled checkpoint for the real
+fidelity number.
+
 The last stdout line is the contract JSON:
 {"metric", "value", "unit", "vs_baseline"}. When no hardware is reachable
 the process exits rc 0 with ``value: 0.0`` and an ``error`` field — "no
@@ -561,6 +571,151 @@ def bench_serving_http(
         "warmup_sec": round(warmup_s, 1),
         "concurrency": concurrency,
         "requests_per_phase": n_req,
+        "n_images": n_images,
+        "max_batch": max_batch,
+    }
+
+
+def bench_tiers(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+):
+    """Fast-tier A/B (docs/SERVING.md "Quality tiers"): the same shuffled
+    mixed-resolution population served through ONE tier-routing
+    ``DynamicBatcher`` — quality (full WaterNet pipeline incl. host
+    WB/GC/CLAHE) vs fast (CAN student, raw RGB in) — plus the int8
+    student served through the identical bucketed machinery. Returns the
+    ``fast_tier_images_per_sec`` contract-line dict: student throughput
+    as ``value``, the teacher arm, the analytic FLOP ratio (the >=5x
+    acceptance assertion lives in tests/test_can.py against the same
+    helper), SSIM-vs-teacher over the stream, and the int8 arm with its
+    error vs the float student.
+
+    Weights: ``WATERNET_STUDENT_WEIGHTS`` names a distilled checkpoint
+    (then ``ssim_vs_teacher`` is the real fidelity number and
+    ``distilled_student`` is true); without it a fresh student init is
+    served — throughput and FLOPs are weight-independent, and the SSIM
+    field is still reported (labeled undistilled) so the schema is
+    stable.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.inference_engine import InferenceEngine, StudentEngine
+    from waternet_tpu.models import CANStudent
+    from waternet_tpu.models.can import flops_ratio
+    from waternet_tpu.serving import DynamicBatcher, derive_buckets
+    from waternet_tpu.training.metrics import ssim as ssim_fn
+
+    n_images, max_batch, max_buckets = _serving_env_defaults(
+        n_images, max_batch, max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+
+    from waternet_tpu.hub import resolve_weights
+
+    # Real checkpoints when available (WATERNET_TPU_WEIGHTS / ./weights
+    # for the teacher, WATERNET_STUDENT_WEIGHTS for the student) — then
+    # ssim_vs_teacher is the true tier-fidelity number; random inits
+    # otherwise (throughput and FLOPs are weight-independent).
+    params = resolve_weights(None)
+    pretrained_teacher = params is not None
+    if params is None:
+        params = _serving_params()
+    student_env = os.environ.get("WATERNET_STUDENT_WEIGHTS")
+    if student_env:
+        student_params = resolve_weights(student_env)
+    else:
+        student_params = CANStudent().init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 16, 16, 3), jnp.float32)
+        )
+    images, shapes = _serving_population(n_images, base)
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+
+    engine = InferenceEngine(params=params)
+    fast = StudentEngine(params=student_params)
+    t0 = time.perf_counter()
+    batcher = DynamicBatcher(
+        engine, ladder, max_batch=max_batch, fast_engine=fast
+    )
+    warmup_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs_q = batcher.map_ordered(images)
+    teacher_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs_f = batcher.map_ordered(images, tier="fast")
+    fast_s = time.perf_counter() - t0
+    summary = batcher.stats.summary()
+    batcher.close()
+
+    # int8 student through the SAME bucketed serving machinery (its own
+    # batcher: the int8 engine simply plays the engine role).
+    fast_q8 = StudentEngine(
+        params=student_params, quantize=True,
+        calib_batches=[
+            np.stack([im]).astype(np.float32) / 255.0 for im in images[:4]
+        ],
+    )
+    b8 = DynamicBatcher(fast_q8, ladder, max_batch=max_batch)
+    t0 = time.perf_counter()
+    outs_8 = b8.map_ordered(images)
+    int8_s = time.perf_counter() - t0
+    b8.close()
+
+    # SSIM of the fast tier against the quality tier it approximates —
+    # measured on plausible (synthetic underwater) frames, NOT the noise
+    # throughput stream: fidelity on inputs like the ones the student
+    # was distilled on is the number the tier contract is about (noise
+    # images are out-of-distribution for both tiers and SSIM on noise is
+    # ~0 by construction). Fixed [0,1] data range for uint8 images.
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    fid_data = SyntheticPairs(4, base, base, seed=0)
+    fid_frames = np.stack([fid_data.load_pair(i)[0] for i in range(4)])
+    fid_q = engine.enhance(fid_frames)
+    fid_f = fast.enhance(fid_frames)
+    ssims = [
+        float(
+            ssim_fn(
+                jnp.asarray(f[None], jnp.float32) / 255.0,
+                jnp.asarray(q[None], jnp.float32) / 255.0,
+                data_range=1.0,
+            )
+        )
+        for f, q in zip(fid_f, fid_q)
+    ]
+    int8_err = float(
+        np.mean(
+            [
+                np.abs(a.astype(int) - b.astype(int)).mean()
+                for a, b in zip(outs_8, outs_f)
+            ]
+        )
+    )
+
+    teacher_ips = n_images / teacher_s
+    fast_ips = n_images / fast_s
+    return {
+        "metric": "fast_tier_images_per_sec",
+        "value": round(fast_ips, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "teacher_images_per_sec": round(teacher_ips, 2),
+        "speedup_vs_teacher": round(fast_ips / teacher_ips, 2),
+        "flop_ratio": round(
+            flops_ratio(base, base, fast.width, fast.depth), 2
+        ),
+        "ssim_vs_teacher": round(float(np.mean(ssims)), 4),
+        "distilled_student": bool(student_env),
+        "pretrained_teacher": pretrained_teacher,
+        "int8_images_per_sec": round(n_images / int8_s, 2),
+        "int8_speedup_vs_teacher": round((n_images / int8_s) / teacher_ips, 2),
+        "int8_vs_float_student_mean_abs_lvl": round(int8_err, 3),
+        "student_width": fast.width,
+        "student_depth": fast.depth,
+        "tiers": summary["tiers"],
+        "buckets": ladder.describe(),
+        "compiles": summary["compiles"],
+        "warmup_sec": round(warmup_s, 1),
         "n_images": n_images,
         "max_batch": max_batch,
     }
@@ -1146,15 +1301,19 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--config",
-        choices=["train", "video", "serve", "serve_multi", "serve_http"],
+        choices=["train", "video", "serve", "serve_multi", "serve_http",
+                 "tiers"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
         "(mixed-resolution directory inference: bucketed vs "
         "--exact-shapes A/B, docs/SERVING.md), serve_multi "
         "(replica-pool scale-out: N replicas vs 1 on the same stream), "
-        "or serve_http (the HTTP front door end-to-end over real "
-        "sockets: throughput, p99, and shed rate at 2x offered load)",
+        "serve_http (the HTTP front door end-to-end over real "
+        "sockets: throughput, p99, and shed rate at 2x offered load), "
+        "or tiers (quality vs fast CAN-student A/B under per-request "
+        "tier routing: throughput, FLOP ratio, SSIM-vs-teacher, int8 "
+        "arm — docs/SERVING.md 'Quality tiers')",
     )
     parser.add_argument(
         "--batch-size", type=int, default=4,
@@ -1170,6 +1329,7 @@ def main():
         "serve": "mixed_res_dir_images_per_sec",
         "serve_multi": "mixed_res_dir_images_per_sec_multidev",
         "serve_http": "http_images_per_sec",
+        "tiers": "fast_tier_images_per_sec",
     }.get(args.config, "uieb_train_images_per_sec_per_chip")
 
     def _fail(error: str, rc: int = 0):
@@ -1256,6 +1416,10 @@ def main():
 
     if args.config == "serve_http":
         print(json.dumps(bench_serving_http()))
+        return
+
+    if args.config == "tiers":
+        print(json.dumps(bench_tiers()))
         return
 
     # Two lines (see module docstring): the strict apples-to-apples host-fed

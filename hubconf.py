@@ -36,3 +36,27 @@ def waternet(pretrained: bool = True, weights=None, device=None, download=False)
             sys.path.remove(repo)
 
     return _waternet(pretrained=pretrained, weights=weights, download=download)
+
+
+def waternet_student(weights, device=None):
+    """Build the fast-tier CAN student (docs/SERVING.md "Quality tiers"):
+    returns ``(preprocess, postprocess, model)`` where ``model(x)`` takes
+    the raw RGB tensor alone — the student consumes no enhanced variants.
+    ``weights`` must name a distilled student checkpoint (a ``train.py
+    --distill`` product; WaterNet weights are refused with a named
+    tier-mismatch error). ``device`` is accepted for signature symmetry
+    with :func:`waternet` and ignored."""
+    import sys
+    from pathlib import Path
+
+    repo = str(Path(__file__).resolve().parent)
+    added = repo not in sys.path
+    if added:
+        sys.path.insert(0, repo)
+    try:
+        from waternet_tpu.hub import waternet_student as _student
+    finally:
+        if added and repo in sys.path:
+            sys.path.remove(repo)
+
+    return _student(weights)

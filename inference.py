@@ -180,6 +180,25 @@ def parse_args(argv=None):
         "byte-identical at any replica count (docs/SERVING.md).",
     )
     parser.add_argument(
+        "--tier",
+        type=str,
+        default="quality",
+        choices=["quality", "fast"],
+        help="(Optional) Serving tier (docs/SERVING.md 'Quality tiers'): "
+        "'quality' (default) is the full WaterNet pipeline, byte-identical "
+        "to every previous release; 'fast' is the distilled CAN student "
+        "(raw RGB in, no WB/GC/CLAHE, ~1/34 the teacher's FLOPs — needs "
+        "--student-weights locally, or a --serve-url server started with "
+        "one). Unknown names are refused loudly on both sides.",
+    )
+    parser.add_argument(
+        "--student-weights",
+        type=str,
+        default=None,
+        help="(Optional) CAN student checkpoint for --tier fast (a "
+        "train.py --distill product).",
+    )
+    parser.add_argument(
         "--serve-url",
         type=str,
         default=None,
@@ -221,6 +240,35 @@ def calibration_from_sources(files, limit: int = 4):
                 if not ok:
                     break
                 batches.append(as_batch(cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)))
+            cap.release()
+    return batches or None  # fall back to synthetic defaults if unreadable
+
+
+def raw_calibration_from_sources(files, limit: int = 4):
+    """Raw-frame [0, 1] calibration batches for the fast tier's int8
+    student (`waternet_tpu.models.quant.quantize_can`): decode only —
+    the student consumes no WB/GC/CLAHE, so none are computed here
+    (unlike :func:`calibration_from_sources`, whose enhanced-variant
+    planes the teacher's calibration needs)."""
+    import cv2
+
+    batches = []
+    for f in files:
+        if len(batches) >= limit:
+            break
+        if f.suffix.lower() in IM_SUFFIXES:
+            im = cv2.imread(str(f))
+            if im is not None:
+                rgb = cv2.cvtColor(im, cv2.COLOR_BGR2RGB)
+                batches.append(rgb[None].astype(np.float32) / 255.0)
+        elif f.suffix.lower() in VID_SUFFIXES:
+            cap = cv2.VideoCapture(str(f))
+            while len(batches) < limit:
+                ok, frame = cap.read()
+                if not ok:
+                    break
+                rgb = cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+                batches.append(rgb[None].astype(np.float32) / 255.0)
             cap.release()
     return batches or None  # fall back to synthetic defaults if unreadable
 
@@ -318,7 +366,7 @@ def run_images_batched(
 def run_images_bucketed(
     engine, paths, savedir: Path, show_split: bool, batch_size: int,
     workers: int = 2, buckets: str = "auto", max_wait_ms: float = 20.0,
-    max_buckets: int = 3, replicas="auto",
+    max_buckets: int = 3, replicas="auto", tier: str = "quality",
 ):
     """Enhance a directory through the shape-bucketed serving engine
     (docs/SERVING.md) — the default for directory sources.
@@ -348,6 +396,9 @@ def run_images_bucketed(
     batcher = DynamicBatcher(
         engine, ladder, max_batch=batch_size, max_wait_ms=max_wait_ms,
         replicas=replicas,
+        # Label the stats by the tier actually served (--tier fast runs
+        # the StudentEngine as this batcher's one and only pool).
+        tier_name=tier,
     )
     print(
         f"Serving buckets: {', '.join(batcher.ladder.describe())} "
@@ -385,6 +436,7 @@ def run_images_bucketed(
 
 def run_images_remote(
     url: str, paths, savedir: Path, show_split: bool, max_retries: int = 10,
+    tier: str = "quality",
 ):
     """Thin client for the HTTP front door (docs/SERVING.md "Front
     door"): POST each image file's bytes to ``<url>/enhance`` and write
@@ -398,6 +450,12 @@ def run_images_remote(
     the service are behaviorally interchangeable. A 429 (admission
     control shedding) is retried after the server's ``Retry-After``, up
     to ``max_retries`` times; any other non-200 aborts loudly.
+
+    ``tier`` is forwarded as the ``X-Tier`` header so the server routes
+    to the quality pipeline or the fast CAN student (docs/SERVING.md
+    "Quality tiers"); it is validated HERE too — an unknown name never
+    reaches the wire (and the server's own 400 is pinned in tests), so a
+    typo'd tier can't silently serve the wrong model.
     """
     import http.client
     import time as _time
@@ -405,6 +463,11 @@ def run_images_remote(
 
     import cv2
 
+    tier = str(tier).lower()
+    if tier not in ("quality", "fast"):
+        raise SystemExit(
+            f"unknown tier {tier!r}: valid tiers are 'quality' and 'fast'"
+        )
     u = urlparse(url)
     conn = http.client.HTTPConnection(
         u.hostname, u.port or 80, timeout=300
@@ -419,7 +482,10 @@ def run_images_remote(
             for attempt in range(max_retries + 1):
                 conn.request(
                     "POST", "/enhance", body=data,
-                    headers={"Content-Type": "application/octet-stream"},
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                        "X-Tier": tier,
+                    },
                 )
                 resp = conn.getresponse()
                 body = resp.read()
@@ -511,7 +577,9 @@ def main(argv=None):
             )
         print(f"Total images/videos: {len(files)}")
         savedir = next_run_dir(Path(__file__).parent / "output", args.name)
-        run_images_remote(args.serve_url, files, savedir, args.show_split)
+        run_images_remote(
+            args.serve_url, files, savedir, args.show_split, tier=args.tier
+        )
         print(f"Saved output to {savedir}!")
         return
     from waternet_tpu.utils.platform import ensure_platform
@@ -539,23 +607,49 @@ def main(argv=None):
     print(f"Total images/videos: {len(files)}")
 
     weights = args.weights
-    if weights is None and args.download:
+    if weights is None and args.download and args.tier != "fast":
+        # (The fast tier never loads the teacher checkpoint — don't
+        # fetch one just to ignore it.)
         from waternet_tpu.hub import download_weights, find_weights_path
 
         if find_weights_path() is None:  # only touch the network when needed
             weights = str(download_weights())
 
-    engine = InferenceEngine(
-        weights=weights,
-        device_preprocess=args.device_preprocess,
-        dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
-        spatial_shards=args.spatial_shards,
-        data_shards=args.data_shards,
-        quantize=args.quantize,
-        # Calibrate int8 activation scales on the ACTUAL inputs (not the
-        # synthetic defaults) so out-of-range activations aren't clipped.
-        calib_batches=calibration_from_sources(files) if args.quantize else None,
-    )
+    if args.tier == "fast":
+        # The fast tier is the distilled CAN student (docs/SERVING.md
+        # "Quality tiers"): raw RGB in, no WB/GC/CLAHE anywhere, ~1/34
+        # the teacher's FLOPs. Single-chip by design — sharding and
+        # device-preprocess flags contradict it loudly.
+        if args.device_preprocess or args.spatial_shards > 1 or args.data_shards > 1:
+            raise SystemExit(
+                "--tier fast is incompatible with --device-preprocess/"
+                "--spatial-shards/--data-shards: the student has no "
+                "preprocessing to move and fits on one chip by design"
+            )
+        from waternet_tpu.inference_engine import StudentEngine
+
+        engine = StudentEngine(
+            weights=args.student_weights,
+            dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+            quantize=args.quantize,
+            # Raw frames only — the student has no enhanced variants to
+            # calibrate, so none are computed.
+            calib_batches=(
+                raw_calibration_from_sources(files) if args.quantize else None
+            ),
+        )
+    else:
+        engine = InferenceEngine(
+            weights=weights,
+            device_preprocess=args.device_preprocess,
+            dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+            spatial_shards=args.spatial_shards,
+            data_shards=args.data_shards,
+            quantize=args.quantize,
+            # Calibrate int8 activation scales on the ACTUAL inputs (not the
+            # synthetic defaults) so out-of-range activations aren't clipped.
+            calib_batches=calibration_from_sources(files) if args.quantize else None,
+        )
 
     savedir = next_run_dir(Path(__file__).parent / "output", args.name)
     # Directory image sources ride the shape-bucketed serving engine by
@@ -578,6 +672,7 @@ def main(argv=None):
                 args.batch_size, workers=args.workers,
                 buckets=args.serve_buckets, max_wait_ms=args.max_wait_ms,
                 max_buckets=args.max_buckets, replicas=args.serve_replicas,
+                tier=args.tier,
             )
         else:
             run_images_batched(

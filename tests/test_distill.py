@@ -1,0 +1,224 @@
+"""Distillation of the quality pipeline into the CAN student
+(train.py --distill, TrainConfig.distill — docs/SERVING.md "Quality
+tiers").
+
+The headline pin — student SSIM-vs-teacher >= 0.90 — is asserted on the
+committed fixture pair (tests/fixtures/distill/, produced by the real
+``TrainingEngine(distill=True)`` recipe in tools/distill_fixture.py):
+re-running minutes of CPU distillation inside tier-1's budget would buy
+nothing over evaluating the committed artifact of exactly that run. The
+live distillation path itself is smoke-tested separately (a few epochs:
+loss falls, metrics provably track the TEACHER, the CLI round-trips into
+a servable student checkpoint).
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sys
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.distill_fixture import (  # noqa: E402  (the committed recipe's constants)
+    FIXTURE_DIR,
+    HW,
+    N_IMAGES,
+    SEED,
+    STUDENT_DEPTH,
+    STUDENT_WIDTH,
+)
+from waternet_tpu.data.synthetic import SyntheticPairs  # noqa: E402
+from waternet_tpu.training.trainer import TrainConfig, TrainingEngine  # noqa: E402
+
+#: The explicit acceptance threshold: the smoke-distilled student must
+#: reproduce its teacher at SSIM >= 0.90 on the val split (the engine's
+#: own distill metric — in distill mode val ssim IS ssim-vs-teacher).
+SSIM_VS_TEACHER_FLOOR = 0.90
+
+
+@pytest.fixture(scope="module")
+def fixture_pair():
+    from waternet_tpu.hub import resolve_weights
+
+    teacher = resolve_weights(str(FIXTURE_DIR / "teacher.npz"))
+    student = resolve_weights(str(FIXTURE_DIR / "student.npz"))
+    return teacher, student
+
+
+@pytest.fixture(scope="module")
+def data():
+    return SyntheticPairs(N_IMAGES, HW, HW, seed=SEED)
+
+
+def _distill_config(**overrides):
+    base = dict(
+        batch_size=N_IMAGES, im_height=HW, im_width=HW, precision="fp32",
+        perceptual_weight=0.0, augment=False, seed=SEED, distill=True,
+        student_width=STUDENT_WIDTH, student_depth=STUDENT_DEPTH,
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+def test_distilled_student_ssim_vs_teacher_pinned(fixture_pair, data):
+    """THE tentpole fidelity pin: the committed smoke-distilled student
+    reproduces the full WaterNet pipeline's output at SSIM >=
+    {SSIM_VS_TEACHER_FLOOR} on the val split. The fixture is the real
+    product of TrainingEngine(distill=True) (tools/distill_fixture.py);
+    regenerating it must keep clearing this floor."""
+    teacher, student = fixture_pair
+    eng = TrainingEngine(
+        _distill_config(), params=student, teacher_params=teacher
+    )
+    idx = np.arange(N_IMAGES)
+    val = eng.eval_epoch(data.batches(idx, N_IMAGES, shuffle=False))
+    assert val["ssim"] >= SSIM_VS_TEACHER_FLOOR, (
+        f"student-vs-teacher SSIM {val['ssim']:.4f} under the "
+        f"{SSIM_VS_TEACHER_FLOOR} floor — the fast tier no longer "
+        "approximates the quality tier"
+    )
+    assert val["psnr"] >= 30.0  # and the pixel bound that came with it
+
+
+def test_distill_metrics_track_teacher_not_ref(fixture_pair, data):
+    """In distill mode the ground-truth ref must be INERT: metrics and
+    losses read student-vs-teacher. Decisive check: evaluating with the
+    real refs and with garbage refs yields identical metrics."""
+    teacher, student = fixture_pair
+    eng = TrainingEngine(
+        _distill_config(), params=student, teacher_params=teacher
+    )
+    idx = np.arange(N_IMAGES)
+    real = eng.eval_epoch(data.batches(idx, N_IMAGES, shuffle=False))
+
+    rng = np.random.default_rng(0)
+
+    def garbage_batches():
+        for raw, ref in data.batches(idx, N_IMAGES, shuffle=False):
+            yield raw, rng.integers(0, 256, ref.shape, dtype=np.uint8)
+
+    garbage = eng.eval_epoch(garbage_batches())
+    for k in ("mse", "ssim", "psnr"):
+        assert real[k] == pytest.approx(garbage[k]), (
+            f"{k} depends on the ground-truth ref in distill mode — "
+            "metrics are supposed to be student-vs-teacher"
+        )
+
+
+def test_live_distill_smoke_loss_falls(fixture_pair, data):
+    """A few epochs of the real distillation path from a fresh student:
+    the loss falls and SSIM-vs-teacher rises — the recipe the fixture
+    was produced by still trains."""
+    teacher, _ = fixture_pair
+    eng = TrainingEngine(_distill_config(lr=3e-3), teacher_params=teacher)
+    idx = np.arange(N_IMAGES)
+    first = last = None
+    for epoch in range(8):
+        m = eng.train_epoch(
+            data.batches(idx, N_IMAGES, shuffle=True, seed=SEED, epoch=epoch),
+            epoch=epoch,
+        )
+        if first is None:
+            first = m
+        last = m
+    assert last["loss"] < 0.5 * first["loss"], (first["loss"], last["loss"])
+    assert np.isfinite(last["loss"])
+
+
+def test_distill_with_perceptual_term_traces(fixture_pair, data):
+    """The Perceptual-Losses distillation recipe (VGG term on
+    student-vs-teacher-output) compiles and yields finite losses."""
+    teacher, _ = fixture_pair
+    eng = TrainingEngine(
+        _distill_config(perceptual_weight=0.05, batch_size=4),
+        teacher_params=teacher,
+    )
+    idx = np.arange(4)
+    m = eng.train_epoch(
+        data.batches(idx, 4, shuffle=False, seed=SEED, epoch=0), epoch=0
+    )
+    assert np.isfinite(m["loss"]) and np.isfinite(m["perceptual_loss"])
+    assert m["perceptual_loss"] > 0.0
+
+
+def test_distill_guards(fixture_pair):
+    teacher, _ = fixture_pair
+    with pytest.raises(ValueError, match="teacher weights"):
+        TrainingEngine(_distill_config())
+    with pytest.raises(ValueError, match="data parallelism only"):
+        TrainingEngine(
+            _distill_config(spatial_shards=2), teacher_params=teacher
+        )
+    eng = TrainingEngine(
+        _distill_config(precache_vgg_ref=True, perceptual_weight=0.05),
+        teacher_params=teacher,
+    )
+    with pytest.raises(ValueError, match="incompatible with distill"):
+        eng.cache_dataset(SyntheticPairs(2, HW, HW, seed=0), np.arange(2))
+
+
+def test_distill_cli_produces_servable_student(tmp_path, monkeypatch, data):
+    """train.py --distill end to end at smoke scale: the run's last.npz
+    is a student checkpoint the fast tier loads and serves (the
+    tier/weights validation accepts it), and config.json records the
+    distillation."""
+    import train as cli
+
+    d = tmp_path / "run"
+    monkeypatch.setattr(
+        "waternet_tpu.utils.rundir.next_run_dir", lambda base, name=None: d
+    )
+    cli.main(
+        [
+            "--distill", "--teacher-weights", str(FIXTURE_DIR / "teacher.npz"),
+            "--student-width", "8", "--student-depth", "4",
+            "--synthetic", "4", "--batch-size", "4", "--height", str(HW),
+            "--width", str(HW), "--epochs", "1", "--no-perceptual",
+            "--precision", "fp32", "--workers", "0",
+        ]
+    )
+    cfg = json.loads((d / "config.json").read_text())
+    assert cfg["distill"] is True
+    assert cfg["student_width"] == 8 and cfg["student_depth"] == 4
+
+    from waternet_tpu.inference_engine import StudentEngine
+
+    eng = StudentEngine(weights=str(d / "last.npz"))
+    assert (eng.width, eng.depth) == (8, 4)
+    out = eng.enhance(np.zeros((1, HW, HW, 3), np.uint8))
+    assert out.shape == (1, HW, HW, 3) and out.dtype == np.uint8
+
+
+def test_hub_student_triple_loads_fixture(fixture_pair, data):
+    """hub.waternet_student: the fast tier's (preprocess, postprocess,
+    model) triple — single-input call shape, loads the distilled
+    checkpoint, refuses teacher weights with the tier-mismatch error."""
+    from waternet_tpu.hub import waternet_student
+
+    preprocess, postprocess, model = waternet_student(
+        str(FIXTURE_DIR / "student.npz")
+    )
+    raw, _ = data.load_pair(0)
+    out = postprocess(model(preprocess(raw)))
+    assert out.shape == (1,) + raw.shape and out.dtype == np.uint8
+
+    with pytest.raises(ValueError, match="quality-tier WaterNet weights"):
+        waternet_student(str(FIXTURE_DIR / "teacher.npz"))
+    with pytest.raises(FileNotFoundError, match="explicit student"):
+        waternet_student(None)
+
+
+def test_distill_cli_flag_conflicts():
+    import train as cli
+
+    with pytest.raises(SystemExit, match="incompatible with --distill"):
+        cli.main(
+            ["--distill", "--precache-vgg-ref", "--device-cache",
+             "--synthetic", "2", "--epochs", "0"]
+        )

@@ -63,3 +63,47 @@ def test_calib_without_quantize_rejected(setup, tmp_path):
     _, params = setup
     with pytest.raises(ValueError, match="quantize=True"):
         save_artifact(tmp_path / "x", params, calib_batches=[])
+
+
+def test_student_artifact_roundtrip(tmp_path):
+    """The fast tier's deployment story (arch='can'): one shape-
+    polymorphic single-input artifact per student, float and int8, with
+    the tier/weights validation carried into export."""
+    from waternet_tpu.models import CANStudent, WaterNet
+    from waternet_tpu.models.quant import default_can_calibration_inputs
+
+    module = CANStudent(width=8, depth=4)
+    params = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3), jnp.float32)
+    )
+    path = save_artifact(tmp_path / "student", params, arch="can")
+    run = load_artifact(path)
+    rng = np.random.default_rng(0)
+    for shape in [(1, 24, 24), (2, 17, 33)]:
+        x = jnp.asarray(rng.random(shape + (3,), np.float32))
+        np.testing.assert_allclose(
+            np.asarray(run(x)), np.asarray(module.apply(params, x)),
+            atol=1e-6,
+        )
+
+    # int8 student artifact: same calibrated forward, baked.
+    calib = default_can_calibration_inputs(n=2, hw=24)
+    p_q = save_artifact(
+        tmp_path / "student_q", params, arch="can", quantize=True,
+        calib_batches=calib,
+    )
+    run_q = load_artifact(p_q)
+    x = jnp.asarray(rng.random((1, 24, 24, 3), np.float32))
+    want = np.asarray(module.apply(params, x))
+    got = np.asarray(run_q(x))
+    err = float(np.mean((want - got) ** 2))
+    peak = float(np.max(np.abs(want))) or 1.0
+    assert 10 * np.log10(peak**2 / err) > 28.0
+
+    # Tier/weights mismatch is loud at export time too.
+    z = jnp.zeros((1, 16, 16, 3))
+    wparams = WaterNet().init(jax.random.PRNGKey(0), z, z, z, z)
+    with pytest.raises(ValueError, match="quality-tier WaterNet weights"):
+        save_artifact(tmp_path / "bad", wparams, arch="can")
+    with pytest.raises(ValueError, match="arch must be"):
+        save_artifact(tmp_path / "bad2", params, arch="resnet")

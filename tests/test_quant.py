@@ -108,6 +108,104 @@ def test_inference_engine_quantized(setup):
     assert np.mean(np.abs(out_q.astype(int) - out_f.astype(int))) < 2.0
 
 
+# ---------------------------------------------------------------------------
+# CAN student int8 (the fast serving tier's quantized forward)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def student_setup():
+    """The committed DISTILLED student (tests/fixtures/distill) plus
+    UIEB-style calibration/eval crops — the int8 bounds are pinned on
+    real fast-tier weights, not a random init."""
+    from pathlib import Path
+
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.hub import resolve_weights
+
+    fixture = Path(__file__).parent / "fixtures" / "distill"
+    params = resolve_weights(str(fixture / "student.npz"))
+    data = SyntheticPairs(8, 24, 24, seed=0)
+    crops = np.stack([data.load_pair(i)[0] for i in range(8)])
+    calib = [crops[:4].astype(np.float32) / 255.0]
+    held_out = crops[4:].astype(np.float32) / 255.0
+    return params, calib, held_out
+
+
+def test_can_functional_float_matches_module(student_setup):
+    from waternet_tpu.models import CANStudent
+    from waternet_tpu.models.quant import can_float_forward
+
+    params, _, held_out = student_setup
+    x = jnp.asarray(held_out)
+    want = CANStudent(width=24, depth=5).apply(params, x)
+    np.testing.assert_array_equal(
+        np.asarray(want), np.asarray(can_float_forward(params, x))
+    )
+
+
+def test_can_int8_error_bound_on_held_out_crops(student_setup):
+    """int8-vs-float student error pinned on crops the calibrator never
+    saw — the deployment regime for the served int8 tier."""
+    from waternet_tpu.models.quant import can_float_forward, quantize_can
+
+    params, calib, held_out = student_setup
+    q = quantize_can(params, calib)
+    x = jnp.asarray(held_out)
+    ref = can_float_forward(params, x)
+    from waternet_tpu.models.quant import can_quant_forward
+
+    out = jax.jit(can_quant_forward)(q, x)
+    assert out.dtype == jnp.float32
+    err = float(jnp.mean((out - ref) ** 2))
+    peak = float(jnp.max(jnp.abs(ref))) or 1.0
+    psnr = 10 * np.log10(peak**2 / err)
+    assert psnr > 30.0, f"int8 student PSNR vs float too low: {psnr:.1f} dB"
+    # And in uint8-output terms: a small mean deviation.
+    assert float(jnp.abs(out - ref).mean()) < 0.02
+
+
+def test_can_quantize_deterministic_and_int8(student_setup):
+    from waternet_tpu.models.quant import quantize_can
+
+    params, calib, _ = student_setup
+    q1 = quantize_can(params, calib)
+    q2 = quantize_can(params, calib)
+    assert list(q1) == ["can"]
+    for l1, l2 in zip(q1["can"], q2["can"]):
+        assert l1["wq"].dtype == jnp.int8
+        np.testing.assert_array_equal(np.asarray(l1["wq"]), np.asarray(l2["wq"]))
+        assert float(l1["s_in"]) == float(l2["s_in"])
+        np.testing.assert_array_equal(
+            np.asarray(l1["rescale"]), np.asarray(l2["rescale"])
+        )
+
+
+def test_can_default_calibration_covers_input_range():
+    from waternet_tpu.models.quant import default_can_calibration_inputs
+
+    (batch,) = default_can_calibration_inputs(n=4, hw=24)
+    assert batch.shape == (4, 24, 24, 3) and batch.dtype == np.float32
+    assert 0.0 <= batch.min() and batch.max() <= 1.0
+
+
+def test_student_engine_int8_close_to_float(student_setup):
+    """The served int8 path end to end: StudentEngine(quantize=True)
+    output within a few uint8 levels of the float student engine."""
+    from waternet_tpu.inference_engine import StudentEngine
+
+    params, calib, held_out = student_setup
+    frames = (held_out * 255.0).astype(np.uint8)
+    eng_f = StudentEngine(params=params)
+    eng_q = StudentEngine(params=params, quantize=True, calib_batches=calib)
+    assert eng_q.quantized is True
+    out_f = eng_f.enhance(frames)
+    out_q = eng_q.enhance(frames)
+    assert out_q.shape == frames.shape and out_q.dtype == np.uint8
+    assert np.mean(np.abs(out_q.astype(int) - out_f.astype(int))) < 2.0
+    assert np.abs(out_q.astype(int) - out_f.astype(int)).max() <= 16
+
+
 def test_quantized_spatial_sharded_matches_unsharded(setup):
     """int8 + halo-exchange H-sharding: the quantize/rescale steps are
     pointwise, so windowed slabs reproduce the unsharded int8 forward."""

@@ -385,8 +385,17 @@ def test_stats_schema_and_latency_percentiles():
         "padding_overhead", "compiles", "fallback_native_shapes",
         "shed_count", "deadline_expired", "queue_depth",
         "queue_depth_mean", "queue_depth_max", "replicas",
-        "images_per_sec", "load_imbalance", "per_replica",
+        "images_per_sec", "load_imbalance", "tiers", "per_replica",
     }
+    # Per-tier counters (docs/SERVING.md "Quality tiers"): the quality
+    # tier always reports; a declared-but-idle fast tier shows zeros.
+    assert summary["tiers"]["quality"] == {"requests": 3, "batches": 1}
+    s.declare_tier("fast")
+    assert s.summary()["tiers"]["fast"] == {"requests": 0, "batches": 0}
+    s.record_latency(0.001, replica=0, tier="fast")
+    s.record_batch(n_real=1, n_slots=4, real_px=100, padded_px=400,
+                   tier="fast")
+    assert s.summary()["tiers"]["fast"] == {"requests": 1, "batches": 1}
     # The admission-control fields (front door, docs/SERVING.md): shed and
     # deadline counters accumulate; queue_depth is LIVE via the probe and
     # 0 for stats nothing registered on (ExactShapeBatcher, bare tests).
@@ -905,7 +914,8 @@ def test_bench_serving_multi_scales_on_multicore():
     "config,metric",
     [("serve", "mixed_res_dir_images_per_sec"),
      ("serve_multi", "mixed_res_dir_images_per_sec_multidev"),
-     ("serve_http", "http_images_per_sec")],
+     ("serve_http", "http_images_per_sec"),
+     ("tiers", "fast_tier_images_per_sec")],
 )
 def test_bench_serve_fail_line_keeps_own_metric(config, metric):
     """Unreachable hardware in the serve configs: rc 0 and the

@@ -1,0 +1,441 @@
+"""Per-request quality tiers (docs/SERVING.md "Quality tiers"): the fast
+CAN-student pool under the tier-routing DynamicBatcher, the X-Tier HTTP
+front door path, the thin client's tier forwarding, per-tier stats, the
+both-tiers compile-sentinel guarantee, and the `tiers` bench contract
+line. The quality tier must stay byte-identical to a tier-less batcher
+throughout — pinned here against the same streams.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from waternet_tpu.serving import (
+    BucketLadder,
+    DynamicBatcher,
+    UnknownTier,
+    derive_buckets,
+)
+from waternet_tpu.utils.tensor import ten2arr
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.distill_fixture import FIXTURE_DIR, HW, N_IMAGES, SEED  # noqa: E402
+
+BUCKET = (32, 32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    from waternet_tpu.models import WaterNet
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+
+@pytest.fixture(scope="module")
+def student_params():
+    """The committed DISTILLED student (tests/fixtures/distill) — tier
+    tests exercise real fast-tier weights, not a random init."""
+    from waternet_tpu.hub import resolve_weights
+
+    return resolve_weights(str(FIXTURE_DIR / "student.npz"))
+
+
+@pytest.fixture(scope="module")
+def teacher_params():
+    from waternet_tpu.hub import resolve_weights
+
+    return resolve_weights(str(FIXTURE_DIR / "teacher.npz"))
+
+
+@pytest.fixture(scope="module")
+def mixed_images(rng):
+    return [
+        np.asarray(rng.integers(0, 256, (24 + i, 26, 3)), dtype=np.uint8)
+        for i in range(6)
+    ]
+
+
+def _student_engine(student_params):
+    from waternet_tpu.inference_engine import StudentEngine
+
+    return StudentEngine(params=student_params)
+
+
+# ---------------------------------------------------------------------------
+# Batcher-level routing
+# ---------------------------------------------------------------------------
+
+
+def test_tier_routing_quality_byte_identity_and_stats(
+    params, student_params, mixed_images
+):
+    """One stream, both tiers: (a) quality outputs through a tier-routing
+    batcher are byte-identical to a tier-less batcher's (the existing
+    serving exactness pins remain authoritative for them); (b) fast
+    outputs equal the student's offline enhance_padded, cropped; (c)
+    per-tier request/batch counters account for every request; (d)
+    unknown tiers and unconfigured fast are refused loudly."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    ladder = BucketLadder([BUCKET])
+    fast = _student_engine(student_params)
+    with DynamicBatcher(
+        InferenceEngine(params=params), ladder, max_batch=4, max_wait_ms=5,
+        fast_engine=fast,
+    ) as b:
+        assert b.tiers == ("fast", "quality")
+        outs_q = b.map_ordered(mixed_images)  # default tier: quality
+        outs_f = b.map_ordered(mixed_images, tier="fast")
+        with pytest.raises(UnknownTier, match="unknown tier 'turbo'"):
+            b.submit(mixed_images[0], tier="turbo")
+        stats = b.stats.summary()
+
+    assert stats["tiers"]["quality"]["requests"] == len(mixed_images)
+    assert stats["tiers"]["fast"]["requests"] == len(mixed_images)
+    assert stats["tiers"]["quality"]["batches"] >= 1
+    assert stats["tiers"]["fast"]["batches"] >= 1
+
+    with DynamicBatcher(
+        InferenceEngine(params=params), ladder, max_batch=4, max_wait_ms=5
+    ) as b_plain:
+        outs_plain = b_plain.map_ordered(mixed_images)
+        with pytest.raises(UnknownTier, match="not configured"):
+            b_plain.submit(mixed_images[0], tier="fast")
+        assert b_plain.stats.summary()["tiers"] == {
+            "quality": {
+                "requests": len(mixed_images),
+                "batches": b_plain.stats.summary()["tiers"]["quality"][
+                    "batches"
+                ],
+            }
+        }
+    for a, c in zip(outs_q, outs_plain):
+        np.testing.assert_array_equal(a, c)
+
+    for im, out in zip(mixed_images, outs_f):
+        h, w = im.shape[:2]
+        offline = ten2arr(
+            fast.enhance_padded_async([im], BUCKET, n_slots=4)
+        )[0, :h, :w]
+        np.testing.assert_array_equal(out, offline)
+
+
+def test_both_tiers_warmed_zero_midserve_jit_growth(
+    params, student_params, mixed_images, compile_sentinel
+):
+    """The compile-discipline acceptance criterion with BOTH tiers
+    warmed: the executable grid is 2 x len(buckets) x replicas, all
+    built at warmup, and serving a mixed stream through both tiers grows
+    no jit cache on either engine."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    ladder = derive_buckets([im.shape[:2] for im in mixed_images], 2)
+    engine = InferenceEngine(params=params)
+    fast = _student_engine(student_params)
+    b = DynamicBatcher(
+        engine, ladder, max_batch=3, max_wait_ms=5, fast_engine=fast
+    )
+    compile_sentinel.arm(
+        q_forward=engine._forward,
+        q_fused=engine._fused,
+        q_fused_padded=engine._fused_padded,
+        f_forward=fast._forward,
+        f_fused=fast._fused,
+    )
+    try:
+        outs_q = b.map_ordered(mixed_images)
+        outs_f = b.map_ordered(mixed_images, tier="fast")
+        stats = b.stats.summary()
+    finally:
+        b.close()
+    compile_sentinel.check()
+    assert len(outs_q) == len(outs_f) == len(mixed_images)
+    assert stats["compiles"] == 2 * len(ladder)
+    assert stats["fallback_native_shapes"] == 0
+
+
+def test_single_engine_batcher_tier_name_labels_stats(
+    student_params, rng
+):
+    """inference.py --tier fast serves a StudentEngine as the batcher's
+    only pool: tier_name labels the stats by what actually served, the
+    default submit routes to it, and a two-tier batcher refuses the
+    override (its primary IS the quality tier)."""
+    from waternet_tpu.inference_engine import StudentEngine
+
+    imgs = [
+        np.asarray(rng.integers(0, 256, (24, 24, 3)), dtype=np.uint8)
+        for _ in range(3)
+    ]
+    with DynamicBatcher(
+        _student_engine(student_params), BucketLadder([BUCKET]), max_batch=4,
+        max_wait_ms=5, tier_name="fast",
+    ) as b:
+        outs = b.map_ordered(imgs)  # default tier -> the fast pool
+        with pytest.raises(UnknownTier, match="not configured"):
+            b.submit(imgs[0], tier="quality")
+        stats = b.stats.summary()
+    assert len(outs) == 3
+    assert stats["tiers"] == {"fast": {"requests": 3, "batches": 1}}
+
+    with pytest.raises(ValueError, match="tier_name must be"):
+        DynamicBatcher(
+            _student_engine(student_params), BucketLadder([BUCKET]),
+            tier_name="turbo",
+        )
+    with pytest.raises(ValueError, match="primary engine IS the quality"):
+        DynamicBatcher(
+            _student_engine(student_params), BucketLadder([BUCKET]),
+            tier_name="fast", fast_engine=_student_engine(student_params),
+        )
+
+
+def test_fast_tier_oversize_fallback_uses_student(
+    params, student_params, rng
+):
+    """An image no bucket covers still routes by tier: the fast tier's
+    native-shape fallback is the STUDENT's forward."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    fast = _student_engine(student_params)
+    big = np.asarray(rng.integers(0, 256, (48, 70, 3)), dtype=np.uint8)
+    with DynamicBatcher(
+        InferenceEngine(params=params), BucketLadder([BUCKET]), max_batch=2,
+        max_wait_ms=5, fast_engine=fast,
+    ) as b:
+        (out,) = b.map_ordered([big], tier="fast")
+        stats = b.stats.summary()
+    np.testing.assert_array_equal(out, fast.enhance(big[None])[0])
+    assert stats["fallback_native_shapes"] == 1
+    assert stats["tiers"]["fast"]["requests"] == 1
+
+
+def test_fast_tier_approximates_quality_end_to_end(
+    teacher_params, student_params
+):
+    """The tentpole, at the serving layer: the SAME images served
+    through both tiers of one batcher — the fast tier's output tracks
+    the quality tier's (the distilled fixture pair; zero-pad bucket so
+    fidelity isn't confounded by seam reflection inside the student's
+    64 px receptive field)."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.training.metrics import ssim as ssim_fn
+
+    data = SyntheticPairs(N_IMAGES, HW, HW, seed=SEED)
+    images = [data.load_pair(i)[0] for i in range(N_IMAGES)]
+    with DynamicBatcher(
+        InferenceEngine(params=teacher_params),
+        BucketLadder([(HW, HW)]),  # == native shape: no padding at all
+        max_batch=4, max_wait_ms=5,
+        fast_engine=_student_engine(student_params),
+    ) as b:
+        outs_q = b.map_ordered(images)
+        outs_f = b.map_ordered(images, tier="fast")
+    ssims = [
+        float(
+            ssim_fn(
+                jnp.asarray(f[None], jnp.float32) / 255.0,
+                jnp.asarray(q[None], jnp.float32) / 255.0,
+                data_range=1.0,
+            )
+        )
+        for f, q in zip(outs_f, outs_q)
+    ]
+    assert float(np.mean(ssims)) >= 0.85, ssims
+
+
+# ---------------------------------------------------------------------------
+# HTTP front door + thin client
+# ---------------------------------------------------------------------------
+
+
+def _png(img_bgr):
+    import cv2
+
+    ok, buf = cv2.imencode(".png", img_bgr)
+    assert ok
+    return buf.tobytes()
+
+
+def _request(port, method, path, body=None, headers=None, timeout=60.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def test_server_tier_routing_and_thin_client(
+    params, student_params, rng, tmp_path
+):
+    """X-Tier on POST /enhance: default/quality answers byte-identically
+    to the offline quality forward, fast to the offline student forward;
+    unknown names 400 server-side; /stats carries the per-tier counters;
+    and the --serve-url thin client forwards its --tier (fast output
+    lands byte-identically on disk) while refusing unknown tier names
+    before anything touches the wire."""
+    import cv2
+
+    from inference import run_images_remote
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving.server import ServingServer
+
+    engine = InferenceEngine(params=params)
+    fast = _student_engine(student_params)
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=4, max_wait_ms=20,
+        replicas=1, max_queue=64, fast_engine=fast,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        port = srv.bound_port
+        bgr = np.asarray(rng.integers(0, 256, (28, 30, 3)), dtype=np.uint8)
+        rgb = bgr[:, :, ::-1]
+        h, w = rgb.shape[:2]
+
+        def expected(eng):
+            return ten2arr(
+                eng.enhance_padded_async([rgb], BUCKET, n_slots=4)
+            )[0, :h, :w]
+
+        # Default (no header) == explicit quality == offline quality.
+        for headers in ({}, {"X-Tier": "quality"}):
+            status, _, body = _request(
+                port, "POST", "/enhance", body=_png(bgr), headers=headers
+            )
+            assert status == 200
+            got = cv2.cvtColor(
+                cv2.imdecode(np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR),
+                cv2.COLOR_BGR2RGB,
+            )
+            np.testing.assert_array_equal(got, expected(engine))
+
+        status, _, body = _request(
+            port, "POST", "/enhance", body=_png(bgr),
+            headers={"X-Tier": "fast"},
+        )
+        assert status == 200
+        got = cv2.cvtColor(
+            cv2.imdecode(np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR),
+            cv2.COLOR_BGR2RGB,
+        )
+        fast_expected = expected(fast)
+        np.testing.assert_array_equal(got, fast_expected)
+
+        status, _, body = _request(
+            port, "POST", "/enhance", body=_png(bgr),
+            headers={"X-Tier": "turbo"},
+        )
+        assert status == 400
+        assert b"unknown tier" in body
+
+        status, _, body = _request(port, "GET", "/stats")
+        stats = json.loads(body)
+        assert stats["tiers"]["fast"]["requests"] == 1
+        assert stats["tiers"]["quality"]["requests"] == 2
+
+        # Thin client: --tier fast forwarded as X-Tier, same output
+        # layout, byte-for-byte the fast tier's PNG content.
+        src = tmp_path / "src"
+        src.mkdir()
+        cv2.imwrite(str(src / "im.png"), bgr)
+        outdir = tmp_path / "out_fast"
+        run_images_remote(
+            f"http://127.0.0.1:{port}", [src / "im.png"], outdir, False,
+            tier="fast",
+        )
+        written = cv2.cvtColor(
+            cv2.imread(str(outdir / "im.png")), cv2.COLOR_BGR2RGB
+        )
+        np.testing.assert_array_equal(written, fast_expected)
+
+        with pytest.raises(SystemExit, match="unknown tier"):
+            run_images_remote(
+                f"http://127.0.0.1:{port}", [src / "im.png"],
+                tmp_path / "out_bad", False, tier="turbo",
+            )
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+def test_server_without_student_refuses_fast(params, rng):
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving.server import ServingServer
+
+    srv = ServingServer(
+        InferenceEngine(params=params), BucketLadder([BUCKET]), max_batch=4,
+        max_wait_ms=20, replicas=1, max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        bgr = np.asarray(rng.integers(0, 256, (28, 30, 3)), dtype=np.uint8)
+        status, _, body = _request(
+            srv.bound_port, "POST", "/enhance", body=_png(bgr),
+            headers={"X-Tier": "fast"},
+        )
+        assert status == 400
+        assert b"not configured" in body
+        payload = json.loads(body)
+        assert payload["tiers"] == ["quality"]
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+# ---------------------------------------------------------------------------
+# Bench contract
+# ---------------------------------------------------------------------------
+
+
+def test_bench_tiers_contract_line(monkeypatch):
+    """The fast_tier_images_per_sec line: schema, the CPU-smoke
+    student-faster acceptance criterion, the FLOP-ratio field, and the
+    distilled-fixture SSIM field wired through WATERNET_STUDENT_WEIGHTS."""
+    monkeypatch.setenv(
+        "WATERNET_STUDENT_WEIGHTS", str(FIXTURE_DIR / "student.npz")
+    )
+    monkeypatch.setenv(
+        "WATERNET_TPU_WEIGHTS", str(FIXTURE_DIR / "teacher.npz")
+    )
+    import bench
+
+    line = bench.bench_tiers(
+        n_images=8, max_batch=3, max_buckets=2, base_hw=24
+    )
+    assert line["metric"] == "fast_tier_images_per_sec"
+    assert line["unit"] == "images/sec/chip"
+    assert line["value"] > 0
+    assert line["teacher_images_per_sec"] > 0
+    # The acceptance criterion: on CPU smoke the student is faster.
+    assert line["speedup_vs_teacher"] > 1.0, line
+    assert line["flop_ratio"] >= 5.0
+    assert line["distilled_student"] is True
+    assert line["pretrained_teacher"] is True
+    # With the real fixture pair loaded, the fidelity column is the
+    # distillation result itself (in-distribution frames at the
+    # fixture's training size).
+    assert line["ssim_vs_teacher"] >= 0.8, line["ssim_vs_teacher"]
+    assert line["int8_images_per_sec"] > 0
+    assert line["int8_vs_float_student_mean_abs_lvl"] < 8.0
+    assert line["student_width"] == 24
+    assert line["tiers"]["fast"]["requests"] == 8
+    assert line["tiers"]["quality"]["requests"] == 8
+    assert line["compiles"] >= 2 * len(line["buckets"])
+    json.dumps(line)  # contract line must be JSON-serializable

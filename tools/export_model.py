@@ -24,12 +24,19 @@ def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--weights", default=None,
                    help="checkpoint (.npz or reference .pt); default: "
-                   "standard resolution order (env, ./weights)")
+                   "standard resolution order (env, ./weights). With "
+                   "--arch can this must be an explicit student checkpoint "
+                   "(a train.py --distill product)")
     p.add_argument("--out", default="waternet.stablehlo")
     p.add_argument("--quantize", action="store_true",
                    help="bake the int8 forward (static calibration on "
                    "synthetic frames; use the library API for custom "
                    "calibration batches)")
+    p.add_argument("--arch", default="waternet", choices=["waternet", "can"],
+                   help="which tier's model to export: 'waternet' (quality "
+                   "teacher, 4-input forward) or 'can' (fast-tier distilled "
+                   "student, single-input; width/depth inferred and "
+                   "validated from the checkpoint)")
     args = p.parse_args()
 
     from waternet_tpu.utils.platform import ensure_platform
@@ -39,14 +46,24 @@ def main():
     from waternet_tpu.export import save_artifact
     from waternet_tpu.hub import resolve_weights
 
+    if args.arch == "can" and args.weights is None:
+        raise SystemExit(
+            "--arch can needs an explicit --weights student checkpoint "
+            "(the implicit resolution is reserved for the teacher)"
+        )
     params = resolve_weights(args.weights)
     if params is None:
         raise SystemExit(
             "no weights found — pass --weights or set WATERNET_TPU_WEIGHTS"
         )
-    path = save_artifact(args.out, params, quantize=args.quantize)
+    path = save_artifact(
+        args.out, params, quantize=args.quantize, arch=args.arch
+    )
     kind = "int8" if args.quantize else "float"
-    print(f"wrote {kind} artifact: {path} ({path.stat().st_size} bytes)")
+    print(
+        f"wrote {kind} {args.arch} artifact: {path} "
+        f"({path.stat().st_size} bytes)"
+    )
 
 
 if __name__ == "__main__":

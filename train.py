@@ -89,6 +89,14 @@ def parse_args(argv=None):
     p.add_argument("--nan-guard", action="store_true",
                    help="Divergence sentinel: verify step losses are finite (in windowed deferred fetches), roll back to the last-good snapshot and skip the offending batch on NaN/Inf, bounded per epoch")
     p.add_argument("--tensorboard", action="store_true", help="Write TensorBoard scalars to <rundir>/tb")
+    p.add_argument("--distill", action="store_true",
+                   help="Distill the full quality pipeline into a compact CAN student (the fast serving tier, docs/SERVING.md 'Quality tiers'): the trained model becomes models/can.CANStudent mapping raw RGB directly to the frozen WaterNet teacher's output; every loss and metric (incl. the val ssim/psnr columns) reads as student-vs-teacher fidelity. Teacher weights come from --teacher-weights (or the standard weight resolution); --weights still names the TRAINED model's starting weights (a student checkpoint to continue from)")
+    p.add_argument("--teacher-weights", type=str,
+                   help="Frozen teacher checkpoint for --distill (.npz or reference .pt); defaults to the standard weight resolution (env, ./weights)")
+    p.add_argument("--student-width", type=int, default=24,
+                   help="--distill: CAN student channel width (default 24)")
+    p.add_argument("--student-depth", type=int, default=7,
+                   help="--distill: CAN student 3x3 stage count (default 7; dilations 1,2,...,2^(depth-2),1)")
     p.add_argument("--synthetic", type=int, default=0, metavar="N", help="Train on N synthetic pairs instead of reading a dataset")
     p.add_argument("--profile-dir", type=str, help="Capture a jax.profiler trace of the first post-compilation epoch (epoch 2, or epoch 1 when --epochs 1) into this dir")
     p.add_argument("--debug-nans", action="store_true", help="Enable jax NaN checking (slower; for debugging diverging runs)")
@@ -176,6 +184,17 @@ def main(argv=None):
 
     print(f"Devices: {jax.devices()}")
 
+    if args.distill and args.precache_vgg_ref:
+        raise SystemExit(
+            "--precache-vgg-ref is incompatible with --distill (the "
+            "distillation target is the teacher output, not the ground-"
+            "truth ref the precached table holds)"
+        )
+    if args.distill and args.spatial_shards > 1:
+        raise SystemExit(
+            "--distill supports data parallelism only for now (the "
+            "student's dilated convs would need 64-row spatial halos)"
+        )
     config = TrainConfig(
         epochs=args.epochs,
         batch_size=args.batch_size,
@@ -190,6 +209,9 @@ def main(argv=None):
         spatial_shards=args.spatial_shards,
         precache_histeq=not args.no_precache_histeq,
         precache_vgg_ref=args.precache_vgg_ref,
+        distill=args.distill,
+        student_width=args.student_width,
+        student_depth=args.student_depth,
     )
 
     # --- data ---
@@ -236,8 +258,22 @@ def main(argv=None):
         params = resolve_weights(args.weights)
         if params is None:
             raise FileNotFoundError(f"could not load weights from {args.weights}")
+    teacher_params = None
+    if args.distill:
+        from waternet_tpu.hub import resolve_weights
+
+        teacher_params = resolve_weights(args.teacher_weights)
+        if teacher_params is None:
+            raise SystemExit(
+                "--distill needs frozen teacher weights: pass "
+                "--teacher-weights, set WATERNET_TPU_WEIGHTS, or place the "
+                "quality checkpoint in ./weights"
+            )
     vgg_params = None if args.no_perceptual else resolve_vgg_params(args.vgg_weights)
-    engine = TrainingEngine(config, params=params, vgg_params=vgg_params)
+    engine = TrainingEngine(
+        config, params=params, vgg_params=vgg_params,
+        teacher_params=teacher_params,
+    )
     saved_train = {k: [] for k in TRAIN_METRICS_NAMES}
     saved_val = {k: [] for k in VAL_METRICS_NAMES}
     start_epoch = 0
@@ -484,6 +520,9 @@ def main(argv=None):
                 "shuffle": config.shuffle,
                 "augment": config.augment,
                 "device_preprocess": config.device_preprocess,
+                "distill": config.distill,
+                "student_width": config.student_width if config.distill else None,
+                "student_depth": config.student_depth if config.distill else None,
             },
             f,
             indent=4,

@@ -44,10 +44,16 @@ def export_forward(
     calib_batches=None,
     dtype=jnp.float32,
     platforms=("cpu", "tpu"),
+    arch: str = "waternet",
 ):
-    """-> jax.export.Exported of ``(x, wb, ce, gc) -> out`` with symbolic
+    """-> jax.export.Exported of the inference forward with symbolic
     (batch, height, width) and params baked in as constants.
 
+    ``arch`` selects the serving tier's model: ``"waternet"`` (the
+    quality teacher, ``(x, wb, ce, gc) -> out``) or ``"can"`` (the fast
+    tier's distilled student, single-input ``(x) -> out`` — its
+    width/depth are inferred AND validated from the param tree, so a
+    WaterNet checkpoint exported as a student fails with a named diff).
     ``platforms`` controls which backends the artifact is lowered for
     (default: cpu AND tpu, so one file exported anywhere runs on both)."""
     if calib_batches is not None and not quantize:
@@ -55,6 +61,33 @@ def export_forward(
             "calib_batches given without quantize=True — the calibration "
             "data would be silently dropped from a float artifact"
         )
+    if arch not in ("waternet", "can"):
+        raise ValueError(f"arch must be 'waternet' or 'can', got {arch!r}")
+    b, h, w = jexport.symbolic_shape("b, h, w")
+    spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
+    if arch == "can":
+        from waternet_tpu.models import CANStudent
+        from waternet_tpu.models.can import can_config_from_params
+
+        width, depth = can_config_from_params(params)
+        if quantize:
+            from waternet_tpu.models.quant import (
+                can_quant_forward,
+                quantize_can,
+            )
+
+            qtree = quantize_can(params, calib_batches)
+
+            def fn(x):
+                return can_quant_forward(qtree, x)
+
+        else:
+            module = CANStudent(width=width, depth=depth, dtype=dtype)
+
+            def fn(x):
+                return module.apply(params, x)
+
+        return jexport.export(jax.jit(fn), platforms=list(platforms))(spec)
     if quantize:
         from waternet_tpu.models.quant import quant_forward, quantize_waternet
 
@@ -69,8 +102,6 @@ def export_forward(
         def fn(x, wb, ce, gc):
             return module.apply(params, x, wb, ce, gc)
 
-    b, h, w = jexport.symbolic_shape("b, h, w")
-    spec = jax.ShapeDtypeStruct((b, h, w, 3), jnp.float32)
     return jexport.export(jax.jit(fn), platforms=list(platforms))(
         spec, spec, spec, spec
     )
@@ -88,19 +119,16 @@ def save_artifact(path, params, **kwargs) -> Path:
 
 
 def load_artifact(path):
-    """-> callable ``(x, wb, ce, gc) -> out`` from a serialized artifact.
+    """-> callable forward from a serialized artifact: ``(x, wb, ce, gc)
+    -> out`` for a WaterNet export, ``(x) -> out`` for a CAN student one
+    (the arity is the artifact's own).
 
     The returned callable jit-executes the embedded StableHLO; it needs only
     jax at runtime (no waternet_tpu, no checkpoint file).
     """
     exported = jexport.deserialize(Path(path).read_bytes())
 
-    def run(x, wb, ce, gc):
-        return exported.call(
-            jnp.asarray(x, jnp.float32),
-            jnp.asarray(wb, jnp.float32),
-            jnp.asarray(ce, jnp.float32),
-            jnp.asarray(gc, jnp.float32),
-        )
+    def run(*args):
+        return exported.call(*(jnp.asarray(a, jnp.float32) for a in args))
 
     return run

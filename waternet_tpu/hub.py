@@ -60,6 +60,59 @@ class JittedModel:
         return self._jitted(self.params, x, wb, ce, gc)
 
 
+class JittedStudent:
+    """Fast-tier counterpart of :class:`JittedModel`: the distilled CAN
+    student's single-input call shape ``model(x)`` (raw RGB in [0, 1] ->
+    enhanced RGB; no WB/GC/CLAHE variants to feed)."""
+
+    def __init__(self, module, params):
+        self.module = module
+        self.params = params
+        self.apply_fn = module.apply
+        self._jitted = jax.jit(module.apply)
+
+    def __call__(self, x):
+        return self._jitted(self.params, x)
+
+
+def waternet_student(
+    weights, dtype=jnp.float32
+) -> Tuple[Callable, Callable, JittedStudent]:
+    """Build the fast tier's ``(preprocess, postprocess, model)`` triple
+    alongside the teacher's (docs/SERVING.md "Quality tiers").
+
+    ``weights`` must name a distilled student checkpoint explicitly (a
+    ``train.py --distill`` product) — the implicit ./weights resolution
+    is reserved for the teacher, so the two tiers can never silently
+    swap checkpoints. The tree is validated against
+    :class:`waternet_tpu.models.CANStudent` (width/depth inferred), with
+    a named shape diff — and a loud tier-mismatch message when handed
+    WaterNet weights. ``preprocess`` is just uint8 -> [0, 1] scaling:
+    the student consumes raw RGB only.
+    """
+    from waternet_tpu.models import CANStudent
+    from waternet_tpu.models.can import can_config_from_params
+    from waternet_tpu.utils.platform import ensure_platform
+
+    ensure_platform()
+    if weights is None:
+        raise FileNotFoundError(
+            "waternet_student needs an explicit student checkpoint path "
+            "(a train.py --distill product)"
+        )
+    params = resolve_weights(weights)
+    width, depth = can_config_from_params(params)
+    module = CANStudent(width=width, depth=depth, dtype=dtype)
+
+    def preprocess(rgb_arr: np.ndarray):
+        return arr2ten(rgb_arr)
+
+    def postprocess(model_out):
+        return ten2arr(model_out)
+
+    return preprocess, postprocess, JittedStudent(module, params)
+
+
 # The reference's pretrained checkpoint (`/root/reference/hubconf.py:5`,
 # `inference.py:15-21`): the filename embeds the sha256 prefix that
 # torch.hub's check_hash verifies; download_weights reproduces exactly that

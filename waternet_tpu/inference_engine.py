@@ -27,7 +27,81 @@ from waternet_tpu.hub import resolve_weights
 from waternet_tpu.utils.tensor import ten2arr
 
 
-class InferenceEngine:
+class _ServingEngineBase:
+    """Shared serving-interface plumbing for both tier engines.
+
+    The serving layer (batcher / replica pool / warmup, docs/SERVING.md)
+    talks to an *engine interface*, not to :class:`InferenceEngine`
+    specifically: ``enhance_async`` (native-shape fallback),
+    ``enhance_padded_async`` / ``aot_compile_padded`` (the bucketed
+    path), ``replica_params`` (per-device placement), plus the
+    ``data_shards`` / ``spatial_shards`` / ``device_preprocess`` /
+    ``quantized`` attributes. This base holds the parts that are
+    identical for the quality tier (:class:`InferenceEngine`) and the
+    fast tier (:class:`StudentEngine`): sync wrappers, device placement,
+    the bucket canvas padding, and the ShapeDtypeStruct builder AOT
+    lowering uses.
+    """
+
+    data_shards = 1
+    spatial_shards = 1
+    device_preprocess = False
+    quantized = False
+
+    def enhance(self, rgb_batch: np.ndarray) -> np.ndarray:
+        """(N, H, W, 3) uint8 RGB -> (N, H, W, 3) uint8 RGB enhanced."""
+        return ten2arr(self.enhance_async(rgb_batch))
+
+    def replica_params(self, device):
+        """This engine's params placed on ``device`` — one copy per serving
+        replica (waternet_tpu/serving/replicas.py). ``None`` returns the
+        engine's own (default-device) params."""
+        if device is None:
+            return self.params
+        return jax.device_put(self.params, device)
+
+    def pad_raw_to_bucket(self, images, bucket_hw, n_slots=None):
+        """Mixed-native-shape uint8 HWC images -> (uint8 canvas batch,
+        (N, 2) int32 native shapes) at one ``bucket_hw`` canvas shape.
+
+        Only the raw bytes are padded here (reflect, bottom/right); what
+        happens to the canvas is the engine's business — the quality
+        tier's device-preprocess program computes WB/GC/CLAHE statistics
+        over the native region (ops/masked.py), the fast tier's student
+        needs no per-image statistics at all. Batch padding repeats the
+        last image (the conv forward is per-sample independent, so batch
+        padding never changes a real sample's output).
+        """
+        from waternet_tpu.serving.bucketing import pad_to_bucket
+
+        if not images:
+            raise ValueError(
+                "pad_raw_to_bucket got no images: serving batches are "
+                "non-empty by construction"
+            )
+        bh, bw = bucket_hw
+        canvases = [pad_to_bucket(im, bh, bw) for im in images]
+        hw = [(im.shape[0], im.shape[1]) for im in images]
+        if n_slots is not None:
+            if len(canvases) > n_slots:
+                raise ValueError(
+                    f"{len(canvases)} images exceed the compiled batch of "
+                    f"{n_slots} slots"
+                )
+            canvases.extend([canvases[-1]] * (n_slots - len(canvases)))
+            hw.extend([hw[-1]] * (n_slots - len(hw)))
+        return np.stack(canvases), np.asarray(hw, np.int32)
+
+    def _serving_sds(self, shape, dtype, device):
+        sharding = (
+            None if device is None else jax.sharding.SingleDeviceSharding(device)
+        )
+        if sharding is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+
+class InferenceEngine(_ServingEngineBase):
     def __init__(
         self,
         weights=None,
@@ -184,10 +258,6 @@ class InferenceEngine:
                 f"2*HALO={2 * HALO}; use fewer spatial shards for this height"
             )
 
-    def enhance(self, rgb_batch: np.ndarray) -> np.ndarray:
-        """(N, H, W, 3) uint8 RGB -> (N, H, W, 3) uint8 RGB enhanced."""
-        return ten2arr(self.enhance_async(rgb_batch))
-
     def enhance_async(self, rgb_batch: np.ndarray):
         """Launch enhancement without blocking; returns a device array future.
 
@@ -220,14 +290,6 @@ class InferenceEngine:
     # Pad/crop-aware entry points (the shape-bucketed serving path,
     # waternet_tpu/serving/ + docs/SERVING.md)
     # ------------------------------------------------------------------
-
-    def replica_params(self, device):
-        """This engine's params placed on ``device`` — one copy per serving
-        replica (waternet_tpu/serving/replicas.py). ``None`` returns the
-        engine's own (default-device) params."""
-        if device is None:
-            return self.params
-        return jax.device_put(self.params, device)
 
     def preprocess_padded(self, images, bucket_hw, n_slots=None, device=None):
         """Mixed-native-shape uint8 HWC images -> the network's four
@@ -276,46 +338,6 @@ class InferenceEngine:
                 lambda a: jax.device_put(a.astype(np.float32), device) / 255.0
             )
         return to_dev(x), to_dev(wb), to_dev(he), to_dev(gc)
-
-    def pad_raw_to_bucket(self, images, bucket_hw, n_slots=None):
-        """Mixed-native-shape uint8 HWC images -> (uint8 canvas batch,
-        (N, 2) int32 native shapes) at one ``bucket_hw`` canvas shape —
-        the host side of the *device-preprocess* serving path.
-
-        Only the raw bytes are padded here (reflect, bottom/right); the
-        WB/GC/CLAHE statistics are computed on device over the native
-        region by the fused padded program (ops/masked.py), preserving
-        the native-image-first exactness policy without any host-side
-        transform work. Batch padding repeats the last image, as
-        :meth:`preprocess_padded` does.
-        """
-        from waternet_tpu.serving.bucketing import pad_to_bucket
-
-        if not images:
-            raise ValueError(
-                "pad_raw_to_bucket got no images: serving batches are "
-                "non-empty by construction"
-            )
-        bh, bw = bucket_hw
-        canvases = [pad_to_bucket(im, bh, bw) for im in images]
-        hw = [(im.shape[0], im.shape[1]) for im in images]
-        if n_slots is not None:
-            if len(canvases) > n_slots:
-                raise ValueError(
-                    f"{len(canvases)} images exceed the compiled batch of "
-                    f"{n_slots} slots"
-                )
-            canvases.extend([canvases[-1]] * (n_slots - len(canvases)))
-            hw.extend([hw[-1]] * (n_slots - len(hw)))
-        return np.stack(canvases), np.asarray(hw, np.int32)
-
-    def _serving_sds(self, shape, dtype, device):
-        sharding = (
-            None if device is None else jax.sharding.SingleDeviceSharding(device)
-        )
-        if sharding is None:
-            return jax.ShapeDtypeStruct(shape, dtype)
-        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
 
     def aot_compile_padded(self, n_slots: int, bucket_hw, device=None, params=None):
         """AOT-build the serving executable for one (batch, bucket) shape
@@ -377,3 +399,118 @@ class InferenceEngine:
         args = self.preprocess_padded(images, bucket_hw, n_slots, device=device)
         fwd = self._forward if executable is None else executable
         return fwd(p, *args)
+
+
+class StudentEngine(_ServingEngineBase):
+    """Fast-tier inference engine: the distilled CAN student
+    (waternet_tpu/models/can.py), raw uint8 frames in, enhanced uint8
+    frames out — no WB/GC/CLAHE anywhere, on host or device.
+
+    Implements the same serving interface as :class:`InferenceEngine`
+    (enhance / enhance_async / aot_compile_padded / enhance_padded_async
+    / replica_params), so the dynamic batcher serves it as its own
+    AOT-warmed executable grid under the existing bucket ladder and
+    replica pool (``DynamicBatcher(fast_engine=...)``, per-request
+    ``tier="fast"`` routing — docs/SERVING.md "Quality tiers"). The
+    bucketed program is ONE fused XLA program: uint8 canvas -> /255 ->
+    student forward; there is no separate preprocessing stage to fuse.
+
+    ``quantize=True`` converts the checkpoint to static int8 at
+    construction (:func:`waternet_tpu.models.quant.quantize_can`) — the
+    MXU double-rate path, with the int8-vs-float error bound pinned in
+    tests/test_quant.py. Sharding is out of scope for the student (its
+    whole point is fitting comfortably on one chip), so the engine is
+    always one-device-per-replica.
+    """
+
+    def __init__(
+        self,
+        weights=None,
+        params: Optional[dict] = None,
+        dtype=jnp.float32,
+        quantize: bool = False,
+        calib_batches=None,
+    ):
+        from waternet_tpu.models import CANStudent
+        from waternet_tpu.models.can import can_config_from_params
+        from waternet_tpu.utils.platform import ensure_platform
+
+        ensure_platform()
+        if params is None:
+            if weights is None:
+                raise FileNotFoundError(
+                    "the fast tier needs explicit student weights — pass "
+                    "--student-weights (a train.py --distill product); the "
+                    "implicit ./weights resolution is reserved for the "
+                    "quality-tier teacher checkpoint"
+                )
+            params = resolve_weights(weights)
+        # Infers (width, depth) AND validates the tree fits CANStudent —
+        # incl. the loud tier/weights-mismatch error when someone points
+        # the fast tier at a WaterNet checkpoint.
+        width, depth = can_config_from_params(params)
+        self.width, self.depth = width, depth
+        self.module = CANStudent(width=width, depth=depth, dtype=dtype)
+        self.params = params
+        self.quantized = quantize
+
+        if quantize:
+            from waternet_tpu.models.quant import can_quant_forward, quantize_can
+
+            self.params = quantize_can(params, calib_batches)
+            apply_fn = can_quant_forward
+        else:
+            apply_fn = self.module.apply
+
+        _forward = jax.jit(apply_fn)
+
+        def _fused(p, rgb_u8):
+            """uint8 batch (native OR bucket canvas) -> enhanced float
+            batch; the student consumes raw RGB only, so the native and
+            padded serving programs are the same shape-generic function."""
+            return _forward(p, rgb_u8.astype(jnp.float32) / 255.0)
+
+        self._forward = _forward
+        self._fused = jax.jit(_fused)
+
+    def enhance_async(self, rgb_batch: np.ndarray):
+        """Launch enhancement without blocking; returns a device array
+        future (the oversize-fallback path goes through the jit cache,
+        compiling once per unique shape — same contract as the quality
+        engine)."""
+        if len(rgb_batch) == 0:
+            raise ValueError(
+                "enhance_async got an empty batch: enhancement needs at "
+                "least one (H, W, 3) frame"
+            )
+        return self._fused(self.params, jnp.asarray(rgb_batch))
+
+    def aot_compile_padded(self, n_slots: int, bucket_hw, device=None, params=None):
+        """AOT-build the fast tier's serving executable for one (batch,
+        bucket) shape — same ``.lower().compile()`` discipline as the
+        quality engine, so warmup builds the whole grid and no request
+        ever pays a compile (the zero-mid-serve-jit-growth sentinel
+        guarantee covers both tiers, tests/test_tiers.py)."""
+        p = self.params if params is None else params
+        bh, bw = bucket_hw
+        canvas = self._serving_sds((n_slots, bh, bw, 3), jnp.uint8, device)
+        return self._fused.lower(p, canvas).compile()
+
+    def enhance_padded_async(
+        self, images, bucket_hw, n_slots=None, executable=None, params=None,
+        device=None,
+    ):
+        """Launch the bucketed student forward without blocking; returns
+        the device float batch at ``bucket_hw`` (callers crop row ``i``
+        back to ``images[i].shape``). Padding is reflect, bottom/right;
+        the student has no global per-image statistics, so padding only
+        touches the seam band within the CAN receptive radius
+        (:func:`waternet_tpu.models.can.can_receptive_radius` — 64 px at
+        the default depth, vs the teacher's 13)."""
+        p = self.params if params is None else params
+        canvas, _ = self.pad_raw_to_bucket(images, bucket_hw, n_slots)
+        put = jnp.asarray if device is None else (
+            lambda a: jax.device_put(a, device)
+        )
+        fwd = self._fused if executable is None else executable
+        return fwd(p, put(canvas))

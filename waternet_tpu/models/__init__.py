@@ -1,5 +1,6 @@
 """Model layer (L3): Flax modules."""
 
+from waternet_tpu.models.can import CANStudent
 from waternet_tpu.models.waternet import ConfidenceMapGenerator, Refiner, WaterNet
 
-__all__ = ["ConfidenceMapGenerator", "Refiner", "WaterNet"]
+__all__ = ["CANStudent", "ConfidenceMapGenerator", "Refiner", "WaterNet"]

@@ -1,0 +1,206 @@
+"""CAN student: a compact dilated context-aggregation network that maps
+raw RGB directly to enhanced RGB — the fast serving tier.
+
+Per *Fast Image Processing with Fully-Convolutional Networks* (Chen et
+al., arXiv:1709.00643), a small fully-convolutional network whose 3x3
+convs use exponentially growing dilations aggregates global context at a
+tiny, resolution-linear cost and can approximate an entire image-
+processing operator end-to-end. Here the approximated operator is the
+WHOLE WaterNet quality pipeline — host/device WB+GC+CLAHE preprocessing
+*plus* the 4-input gated-fusion forward — distilled into one raw-RGB-in
+network with the *Perceptual Losses* recipe (arXiv:1603.08155) already
+implemented in ``training/losses.py`` (``train.py --distill``,
+docs/SERVING.md "Quality tiers").
+
+Architecture (CAN24-shaped, width/depth configurable):
+
+* ``depth`` 3x3 conv stages of ``width`` channels with dilations
+  ``1, 2, 4, ..., 2^(depth-2), 1`` and LeakyReLU(0.2) — the paper's
+  schedule: the receptive radius is the dilation sum (64 px at the
+  default depth 7, covering the 112^2 training crops);
+* a final linear 1x1 conv to 3 channels, added RESIDUALLY to the input:
+  enhancement is a near-identity operator, so the student learns the
+  correction, not the image.
+
+Why this is the fast tier: the student needs **no WB/GC/CLAHE at all**
+(the ~22 ms/step host-transform cost from the round-5 hardware
+measurement simply disappears) and its conv forward is a small fraction
+of the teacher's — asserted, not vibes: :func:`flops_ratio` computes
+both sides analytically from the layer specs, and tests pin
+``>= 5x`` at 112^2 (the default configuration measures ~34x).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from waternet_tpu.models.waternet import _CMG_SPEC, _REFINER_SPEC
+
+#: Default student shape: width 24, 7 dilated 3x3 stages (+ the 1x1 head).
+DEFAULT_WIDTH = 24
+DEFAULT_DEPTH = 7
+
+
+def can_dilations(depth: int) -> List[int]:
+    """The dilation schedule of the ``depth`` 3x3 stages:
+    ``1, 2, 4, ..., 2^(depth-2)`` then a final dilation-1 stage (the
+    paper's CAN layout). ``depth >= 2`` required — one growing stage and
+    the closing dilation-1 stage are the minimum meaningful network."""
+    if depth < 2:
+        raise ValueError(f"CAN depth must be >= 2, got {depth}")
+    return [2 ** i for i in range(depth - 1)] + [1]
+
+
+def can_receptive_radius(depth: int = DEFAULT_DEPTH) -> int:
+    """Receptive-field radius in pixels: each 3x3 stage at dilation d
+    widens the field by d per side (the 1x1 head adds nothing). The
+    fast tier's analog of ``serving.RECEPTIVE_RADIUS``: output pixels
+    farther than this from a pad seam never see padded content."""
+    return sum(can_dilations(depth))
+
+
+class CANStudent(nn.Module):
+    """Raw RGB in [0, 1] -> enhanced RGB, single input, fully
+    convolutional (any H, W). ``dtype`` controls compute precision
+    (params stay fp32 via Flax's default param_dtype); the residual add
+    and output run in fp32 at the boundary, like WaterNet."""
+
+    width: int = DEFAULT_WIDTH
+    depth: int = DEFAULT_DEPTH
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x) -> jnp.ndarray:
+        h = x.astype(self.dtype)
+        for d in can_dilations(self.depth):
+            h = nn.leaky_relu(
+                nn.Conv(
+                    self.width, (3, 3), kernel_dilation=(d, d),
+                    padding="SAME", dtype=self.dtype,
+                )(h),
+                negative_slope=0.2,
+            )
+        delta = nn.Conv(3, (1, 1), dtype=self.dtype)(h)
+        return (x.astype(jnp.float32) + delta.astype(jnp.float32))
+
+
+# ----------------------------------------------------------------------
+# FLOP accounting — the >=5x cost-reduction acceptance criterion is
+# asserted against these, derived from the same layer specs the modules
+# are built from (a spec change cannot silently drift the claim).
+# ----------------------------------------------------------------------
+
+
+def _conv_flops(h: int, w: int, cin: int, cout: int, k: int) -> int:
+    """2 * MACs of one SAME kxk conv over an (h, w) plane."""
+    return 2 * h * w * cin * cout * k * k
+
+
+def can_forward_flops(
+    h: int, w: int, width: int = DEFAULT_WIDTH, depth: int = DEFAULT_DEPTH
+) -> int:
+    """Per-image forward FLOPs of the student at (h, w)."""
+    total = 0
+    cin = 3
+    for _ in can_dilations(depth):  # dilation does not change the MACs
+        total += _conv_flops(h, w, cin, width, 3)
+        cin = width
+    total += _conv_flops(h, w, cin, 3, 1)
+    return total
+
+
+def waternet_forward_flops(h: int, w: int) -> int:
+    """Per-image forward FLOPs of the WaterNet teacher at (h, w),
+    derived from the module's own ``_CMG_SPEC`` / ``_REFINER_SPEC``."""
+    total = 0
+    cin = 12  # concat(x, wb, ce, gc)
+    for feat, k in _CMG_SPEC:
+        total += _conv_flops(h, w, cin, feat, k)
+        cin = feat
+    total += _conv_flops(h, w, cin, 3, 3)  # sigmoid head
+    refiner = 0
+    cin = 6  # concat(x, variant)
+    for feat, k in _REFINER_SPEC:
+        refiner += _conv_flops(h, w, cin, feat, k)
+        cin = feat
+    refiner += _conv_flops(h, w, cin, 3, 3)
+    return total + 3 * refiner
+
+
+def teacher_pipeline_flops(h: int, w: int) -> int:
+    """Per-image FLOPs of the quality pipeline the student replaces.
+
+    Counted as the WaterNet conv forward alone — deliberately
+    conservative: the WB/GC/CLAHE preprocessing the student ALSO removes
+    is byte-bound, not FLOP-bound (docs/MFU.md round 6: ~0.05 GFLOP but
+    ~73 MB/batch), so adding its FLOPs would barely move this number
+    while its real cost (the ~22 ms/step host transforms) is pure upside
+    for the fast tier on top of the asserted ratio."""
+    return waternet_forward_flops(h, w)
+
+
+def flops_ratio(
+    h: int = 112, w: int = 112,
+    width: int = DEFAULT_WIDTH, depth: int = DEFAULT_DEPTH,
+) -> float:
+    """teacher-pipeline FLOPs / student FLOPs at (h, w) — the asserted
+    cost-reduction factor (>= 5 is the acceptance floor; the default
+    student measures ~34x)."""
+    return teacher_pipeline_flops(h, w) / can_forward_flops(h, w, width, depth)
+
+
+# ----------------------------------------------------------------------
+# Param-tree validation — one vocabulary for "these weights are not a
+# student" (serving engines, hub loaders, hot-reload style checks).
+# ----------------------------------------------------------------------
+
+
+def can_config_from_params(params) -> Tuple[int, int]:
+    """Infer ``(width, depth)`` from a CAN param tree and validate it
+    fits :class:`CANStudent` exactly, via the same
+    ``params_mismatch_report`` vocabulary the trainer restore and the
+    serving hot reload use. Raises ``ValueError`` with a named diff on
+    mismatch — including the common operator error of pointing the fast
+    tier at quality-tier (WaterNet) weights."""
+    from waternet_tpu.utils.checkpoint import params_mismatch_report
+
+    inner = params.get("params", params) if isinstance(params, dict) else None
+    if not isinstance(inner, dict) or not inner:
+        raise ValueError(
+            "student weights are not a CAN param tree (empty or non-dict)"
+        )
+    names = set(inner)
+    if {"cmg", "wb_refiner", "ce_refiner", "gc_refiner"} & names:
+        raise ValueError(
+            "these are quality-tier WaterNet weights (cmg/*_refiner "
+            "branches), not a CAN student checkpoint — pass them to the "
+            "quality engine (--weights), and point --student-weights at a "
+            "distilled student (train.py --distill)"
+        )
+    if any(not n.startswith("Conv_") for n in names):
+        raise ValueError(
+            f"not a CAN student param tree: unexpected top-level keys "
+            f"{sorted(n for n in names if not n.startswith('Conv_'))}"
+        )
+    depth = len(names) - 1  # the 1x1 head is the last conv
+    try:
+        width = int(inner["Conv_0"]["kernel"].shape[-1])
+        dilations = can_dilations(depth)
+    except (KeyError, AttributeError, IndexError, ValueError) as err:
+        raise ValueError(f"malformed CAN student param tree: {err}") from None
+    del dilations
+    expect = CANStudent(width=width, depth=depth).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3), jnp.float32)
+    )
+    have = params if "params" in params else {"params": params}
+    report = params_mismatch_report(have, expect)
+    if report:
+        raise ValueError(
+            f"student weights do not fit CANStudent(width={width}, "
+            f"depth={depth}):\n{report}"
+        )
+    return width, depth

@@ -61,19 +61,21 @@ def _layer_tree(params) -> Dict[str, List[dict]]:
     }
 
 
-def _conv_f32(layer, x):
+def _conv_f32(layer, x, dilation: int = 1):
     y = lax.conv_general_dilated(
         x, layer["kernel"].astype(x.dtype), (1, 1), "SAME",
+        rhs_dilation=(dilation, dilation),
         dimension_numbers=_DN,
     )
     return y + layer["bias"].astype(x.dtype)
 
 
-def _conv_int8(qlayer, x):
+def _conv_int8(qlayer, x, dilation: int = 1):
     """Quantize input with the calibrated scale, int8 conv, float rescale."""
     xq = jnp.clip(jnp.round(x / qlayer["s_in"]), -127, 127).astype(jnp.int8)
     y = lax.conv_general_dilated(
         xq, qlayer["wq"], (1, 1), "SAME",
+        rhs_dilation=(dilation, dilation),
         dimension_numbers=_DN,
         preferred_element_type=jnp.int32,
     )
@@ -160,6 +162,27 @@ def default_calibration_inputs(n: int = 8, hw: int = 112, seed: int = 0):
     return [(f(xs), f(wbs), f(hes), f(gcs))]
 
 
+def _quantize_layers(convs, stats, branch: str) -> List[dict]:
+    """One branch's float conv layers -> int8 layer dicts, with input
+    scales read from the calibration ``stats`` under ``{branch}/{i}``."""
+    qconvs = []
+    for i, layer in enumerate(convs):
+        w = np.asarray(layer["kernel"], np.float32)  # (kh, kw, in, out)
+        s_w = np.abs(w).reshape(-1, w.shape[-1]).max(axis=0) / 127.0
+        s_w = np.maximum(s_w, 1e-12)
+        wq = np.clip(np.round(w / s_w), -127, 127).astype(np.int8)
+        s_in = max(stats[f"{branch}/{i}"], 1e-12) / 127.0
+        qconvs.append(
+            {
+                "wq": jnp.asarray(wq),
+                "bias": jnp.asarray(layer["bias"], jnp.float32),
+                "s_in": jnp.float32(s_in),
+                "rescale": jnp.asarray(s_in * s_w, jnp.float32),
+            }
+        )
+    return qconvs
+
+
 def quantize_waternet(params, calib_batches=None):
     """Float checkpoint -> int8 inference pytree.
 
@@ -171,27 +194,103 @@ def quantize_waternet(params, calib_batches=None):
         calib_batches = default_calibration_inputs()
     stats = calibration_stats(params, calib_batches)
     layers = _layer_tree(params)
-    qtree: Dict[str, List[dict]] = {}
-    for branch, convs in layers.items():
-        qconvs = []
-        for i, layer in enumerate(convs):
-            w = np.asarray(layer["kernel"], np.float32)  # (kh, kw, in, out)
-            s_w = np.abs(w).reshape(-1, w.shape[-1]).max(axis=0) / 127.0
-            s_w = np.maximum(s_w, 1e-12)
-            wq = np.clip(np.round(w / s_w), -127, 127).astype(np.int8)
-            s_in = max(stats[f"{branch}/{i}"], 1e-12) / 127.0
-            qconvs.append(
-                {
-                    "wq": jnp.asarray(wq),
-                    "bias": jnp.asarray(layer["bias"], jnp.float32),
-                    "s_in": jnp.float32(s_in),
-                    "rescale": jnp.asarray(s_in * s_w, jnp.float32),
-                }
-            )
-        qtree[branch] = qconvs
-    return qtree
+    return {
+        branch: _quantize_layers(convs, stats, branch)
+        for branch, convs in layers.items()
+    }
 
 
 def quant_forward(qtree, x, wb, ce, gc):
     """int8 inference forward; jit this (or let InferenceEngine do it)."""
     return _forward(qtree, x, wb, ce, gc, _conv_int8)
+
+
+# ----------------------------------------------------------------------
+# CAN student (models/can.py) — the fast serving tier's int8 forward.
+# Same scheme (static symmetric PTQ, per-output-channel weights,
+# calibrated per-conv-input activation scales), over the student's
+# dilated conv stack. Unlike WaterNet's [0,1]-bounded conv inputs, the
+# student's hidden activations are signed (LeakyReLU) and unbounded, so
+# calibration on representative frames is what pins the scales — the
+# int8-vs-float error bound is tested on held-out UIEB-style crops.
+# ----------------------------------------------------------------------
+
+
+def _can_layers(params) -> List[dict]:
+    """CAN student params -> ordered [ {kernel, bias}, ... ] (the last
+    entry is the linear 1x1 head)."""
+    p = params["params"] if "params" in params else params
+    return [p[f"Conv_{i}"] for i in range(len(p))]
+
+
+def _can_forward(layers, x, conv, observe=None):
+    """Shared CAN topology over a per-layer ``conv`` primitive — must
+    mirror :class:`waternet_tpu.models.can.CANStudent` exactly (pinned
+    bit-identical in tests/test_can.py)."""
+    from waternet_tpu.models.can import can_dilations
+
+    h = x
+    dilations = can_dilations(len(layers) - 1)
+    for i, d in enumerate(dilations):
+        if observe is not None:
+            observe("can", i, h)
+        h = jax.nn.leaky_relu(conv(layers[i], h, d), negative_slope=0.2)
+    if observe is not None:
+        observe("can", len(dilations), h)
+    delta = conv(layers[-1], h, 1)
+    return x.astype(jnp.float32) + delta.astype(jnp.float32)
+
+
+def can_float_forward(params, x):
+    """fp32 reference forward over the functional CAN topology (validated
+    bit-identical to the Flax module in tests/test_can.py)."""
+    return _can_forward(_can_layers(params), x, _conv_f32)
+
+
+def can_calibration_stats(params, batches: Sequence) -> Dict[str, float]:
+    """absmax of every student conv input over raw-RGB calibration
+    batches (float arrays in [0, 1])."""
+    layers = _can_layers(params)
+
+    @jax.jit
+    def one(x):
+        stats = {}
+
+        def observe(branch, i, inp):
+            stats[f"{branch}/{i}"] = jnp.max(jnp.abs(inp))
+
+        _can_forward(layers, x, _conv_f32, observe=observe)
+        return stats
+
+    # Same deferred-fetch discipline as calibration_stats (R003).
+    pending = [one(jnp.asarray(x)) for x in batches]
+    agg: Dict[str, float] = {}
+    for stats in jax.device_get(pending):
+        for k, v in stats.items():
+            agg[k] = max(agg.get(k, 0.0), float(v))
+    return agg
+
+
+def default_can_calibration_inputs(n: int = 8, hw: int = 112, seed: int = 0):
+    """Synthetic raw-RGB calibration frames in [0, 1] — the student's
+    whole input distribution (it consumes no enhanced variants)."""
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    data = SyntheticPairs(n, hw, hw, seed=seed)
+    raw = np.stack([data.load_pair(i)[0] for i in range(n)])
+    return [raw.astype(np.float32) / 255.0]
+
+
+def quantize_can(params, calib_batches=None):
+    """Student float checkpoint -> int8 inference pytree
+    ``{"can": [ {wq, bias, s_in, rescale}, ... ]}`` (deterministic for a
+    given (params, calibration) pair — pinned in tests/test_quant.py)."""
+    if calib_batches is None:
+        calib_batches = default_can_calibration_inputs()
+    stats = can_calibration_stats(params, calib_batches)
+    return {"can": _quantize_layers(_can_layers(params), stats, "can")}
+
+
+def can_quant_forward(qtree, x):
+    """Student int8 inference forward; jit this (or let StudentEngine)."""
+    return _can_forward(qtree["can"], x, _conv_int8)

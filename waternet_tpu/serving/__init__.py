@@ -11,6 +11,7 @@ from waternet_tpu.serving.batcher import (
     DynamicBatcher,
     ExactShapeBatcher,
     QueueFull,
+    UnknownTier,
     fit_ladder_to_engine,
     resolve_ladder,
 )
@@ -40,6 +41,7 @@ __all__ = [
     "QueueFull",
     "ReplicaPool",
     "ServingStats",
+    "UnknownTier",
     "derive_buckets",
     "engine_jit_cache_size",
     "fit_ladder_to_engine",

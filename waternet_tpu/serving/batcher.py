@@ -62,6 +62,14 @@ class QueueFull(RuntimeError):
     later instead of queueing without bound."""
 
 
+class UnknownTier(ValueError):
+    """submit() refused: the requested serving tier is not served by this
+    batcher — either a name outside {quality, fast}, or ``fast`` on a
+    batcher built without a ``fast_engine``. Raised loudly (the HTTP
+    front door answers 400) instead of silently serving the wrong model:
+    a tier is a quality contract, not a routing hint."""
+
+
 class DeadlineExpired(RuntimeError):
     """A request's deadline ran out before its batch was computed. Raised
     from submit() when the deadline is already past at admission, and set
@@ -71,10 +79,16 @@ class DeadlineExpired(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_submit", "t_admit", "deadline")
+    __slots__ = ("image", "future", "t_submit", "t_admit", "deadline", "tier")
 
-    def __init__(self, image: np.ndarray, deadline: Optional[float] = None):
+    def __init__(
+        self,
+        image: np.ndarray,
+        deadline: Optional[float] = None,
+        tier: str = "quality",
+    ):
         self.image = image
+        self.tier = tier
         self.future: Future = Future()
         # t_submit anchors the reported request latency; t_admit (set when
         # the dispatcher moves the request into its bucket's pending list)
@@ -116,7 +130,14 @@ class DynamicBatcher:
       resolves, so this is the knob that keeps RSS and queueing delay
       bounded under overload. The default is generous (the CLI's own
       windowing never comes near it); servers set it to their real
-      watermark (docs/SERVING.md "Front door").
+      watermark (docs/SERVING.md "Front door");
+    * ``fast_engine`` — a :class:`~waternet_tpu.inference_engine.
+      StudentEngine` enabling per-request tier routing (docs/SERVING.md
+      "Quality tiers"): the distilled CAN student gets its OWN replica
+      pool on the same devices and ladder, requests pick a tier at
+      submit (``tier="fast"``; default "quality" is byte-identical to a
+      tier-less batcher), coalescing is per (tier, bucket), and
+      unknown/unconfigured tiers raise :class:`UnknownTier`.
     """
 
     def __init__(
@@ -130,11 +151,28 @@ class DynamicBatcher:
         replicas=1,
         max_inflight_per_replica: int = 2,
         max_queue: int = 8192,
+        fast_engine=None,
+        tier_name: str = "quality",
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        # ``tier_name`` labels the PRIMARY engine's pool in the stats —
+        # "fast" when the CLI serves a StudentEngine alone (--tier fast),
+        # so the stats block names the tier that actually served. A
+        # two-tier batcher keeps the primary as "quality" (the fast pool
+        # is always the student).
+        if tier_name not in ("quality", "fast"):
+            raise ValueError(
+                f"tier_name must be 'quality' or 'fast', got {tier_name!r}"
+            )
+        if fast_engine is not None and tier_name != "quality":
+            raise ValueError(
+                "a two-tier batcher's primary engine IS the quality tier; "
+                "tier_name overrides are for single-engine batchers"
+            )
+        self._default_tier = tier_name
         self.engine = engine
         self.max_batch = int(max_batch)
         if engine.data_shards > 1 and self.max_batch % engine.data_shards:
@@ -154,7 +192,31 @@ class DynamicBatcher:
             n_replicas=resolve_replicas(replicas, engine),
             max_inflight_per_replica=max_inflight_per_replica,
             stats=self.stats, warmup_verbose=warmup_verbose,
+            tier=tier_name,
         )
+        # Per-request tier routing (docs/SERVING.md "Quality tiers"):
+        # ``fast_engine`` (a StudentEngine) gets its OWN replica pool on
+        # the same devices, same ladder, same slot count — its own
+        # AOT-warmed executable grid, launch/completion threads, and
+        # per-tier stats — while quality traffic flows through the pool
+        # above byte-identically to a tier-less batcher. Without it,
+        # tier="fast" submits are refused loudly (UnknownTier).
+        self._pools = {tier_name: self._pool}
+        if fast_engine is not None:
+            if getattr(fast_engine, "data_shards", 1) > 1 or getattr(
+                fast_engine, "spatial_shards", 1
+            ) > 1:
+                raise ValueError(
+                    "the fast tier's student engine is never sharded "
+                    "(its whole point is fitting on one chip)"
+                )
+            self._pools["fast"] = ReplicaPool(
+                fast_engine, ladder, [self.max_batch],
+                n_replicas=self._pool.n_replicas,
+                max_inflight_per_replica=max_inflight_per_replica,
+                stats=self.stats, warmup_verbose=warmup_verbose,
+                tier="fast",
+            )
         self._requests: queue.Queue = queue.Queue()
         self._closed = False
         self.max_queue = int(max_queue)
@@ -186,10 +248,19 @@ class DynamicBatcher:
     def n_replicas(self) -> int:
         return self._pool.n_replicas
 
+    @property
+    def tiers(self) -> Tuple[str, ...]:
+        """The tier names this batcher serves (always includes
+        "quality"; "fast" iff a ``fast_engine`` was configured)."""
+        return tuple(sorted(self._pools))
+
     # -- public API ----------------------------------------------------
 
     def submit(
-        self, image: np.ndarray, deadline: Optional[float] = None
+        self,
+        image: np.ndarray,
+        deadline: Optional[float] = None,
+        tier: Optional[str] = None,
     ) -> Future:
         """Queue one (H, W, 3) uint8 image; resolves to its enhanced
         native-shape uint8 array. Thread-safe.
@@ -201,7 +272,31 @@ class DynamicBatcher:
         request. Either way ``stats.deadline_expired`` counts it. Raises
         :class:`QueueFull` at the ``max_queue`` bound — admission control
         instead of unbounded queueing.
+
+        ``tier`` selects the serving model per request (None defaults to
+        the batcher's primary tier — "quality" unless ``tier_name``
+        renamed a single-engine batcher — byte-identical to a tier-less
+        batcher): "quality" is the full WaterNet pipeline, "fast" the
+        CAN student pool. Any other name — or a tier this batcher does
+        not serve — raises :class:`UnknownTier`.
         """
+        tier = self._default_tier if tier is None else str(tier).lower()
+        if tier not in ("quality", "fast"):
+            raise UnknownTier(
+                f"unknown tier {tier!r}: valid tiers are 'quality' and "
+                "'fast'"
+            )
+        if tier not in self._pools:
+            hint = (
+                " — the fast tier needs a student engine (server: "
+                "--student-weights)"
+                if tier == "fast"
+                else ""
+            )
+            raise UnknownTier(
+                f"tier {tier!r} is not configured on this batcher "
+                f"(serving: {', '.join(sorted(self._pools))}){hint}"
+            )
         if image.ndim != 3 or image.shape[-1] != 3:
             raise ValueError(
                 f"expected one (H, W, 3) image, got shape {image.shape}"
@@ -212,7 +307,7 @@ class DynamicBatcher:
                 "deadline already past at admission (the coalescing window "
                 "plus compute cannot finish in negative time)"
             )
-        req = _Request(image, deadline=deadline)
+        req = _Request(image, deadline=deadline, tier=tier)
         req.future.add_done_callback(self._on_request_resolved)
         with self._submit_lock:
             if self._closed:
@@ -243,17 +338,21 @@ class DynamicBatcher:
             return self._backlog
 
     def set_params(self, params) -> None:
-        """Hot weight reload: atomically swap every replica's params
-        between batches (in-flight batches keep the params they were
-        launched with; no request is dropped). The caller validates
-        shapes/dtypes first — the AOT executables take params as a
-        runtime argument, so same-structure params never recompile."""
+        """Hot weight reload of the QUALITY tier: atomically swap every
+        replica's params between batches (in-flight batches keep the
+        params they were launched with; no request is dropped). The
+        caller validates shapes/dtypes first — the AOT executables take
+        params as a runtime argument, so same-structure params never
+        recompile. The fast tier's student is a separate checkpoint and
+        keeps serving its own weights (restart to swap a student)."""
         self._pool.set_params(params)
 
-    def map_ordered(self, images: Iterable[np.ndarray]) -> List[np.ndarray]:
+    def map_ordered(
+        self, images: Iterable[np.ndarray], tier: Optional[str] = None
+    ) -> List[np.ndarray]:
         """Submit everything, then collect results in submission order —
         the deterministic whole-stream entry point (bench A/B uses it)."""
-        futures = [self.submit(im) for im in images]
+        futures = [self.submit(im, tier=tier) for im in images]
         self.drain()
         return [f.result() for f in futures]
 
@@ -286,11 +385,11 @@ class DynamicBatcher:
     # -- dispatcher ----------------------------------------------------
 
     def _dispatch_loop(self) -> None:
-        pending: dict = {}  # bucket -> [requests, FIFO]
+        pending: dict = {}  # (tier, bucket) -> [requests, FIFO]
 
         def flush_all():
-            for bucket in list(pending):
-                self._flush(bucket, pending.pop(bucket))
+            for key in list(pending):
+                self._flush(key, pending.pop(key))
 
         try:
             while True:
@@ -337,15 +436,19 @@ class DynamicBatcher:
                     break
                 self._sweep(pending)  # idle-queue cycles: deadlines fire here
         finally:
-            self._pool.close()
+            for pool in self._pools.values():
+                pool.close()
 
     def _admit(self, req: _Request, pending: dict) -> None:
         req.t_admit = time.perf_counter()
         h, w = req.image.shape[:2]
         bucket = self.ladder.bucket_for(h, w)
-        pending.setdefault(bucket, []).append(req)
-        if bucket is None or len(pending[bucket]) >= self.max_batch:
-            self._flush(bucket, pending.pop(bucket))
+        # Coalescing is per (tier, bucket): tiers never share a device
+        # batch — a micro-batch runs ONE model on one executable.
+        key = (req.tier, bucket)
+        pending.setdefault(key, []).append(req)
+        if bucket is None or len(pending[key]) >= self.max_batch:
+            self._flush(key, pending.pop(key))
 
     def _eff_deadline(self, req: _Request) -> float:
         """When this request's bucket must flush on its account: the
@@ -362,10 +465,10 @@ class DynamicBatcher:
         (coalescing budget clamped by its own deadline) has passed
         (cheap: O(pending requests) clock checks)."""
         now = time.perf_counter()
-        for bucket in list(pending):
-            reqs = pending[bucket]
+        for key in list(pending):
+            reqs = pending[key]
             if reqs and min(self._eff_deadline(r) for r in reqs) <= now:
-                self._flush(bucket, pending.pop(bucket))
+                self._flush(key, pending.pop(key))
 
     def _next_deadline(self, pending: dict) -> Optional[float]:
         soonest = None
@@ -377,15 +480,16 @@ class DynamicBatcher:
             return None  # idle: block until the next request
         return max(0.0, soonest - time.perf_counter())
 
-    def _flush(self, bucket, reqs: List[_Request]) -> None:
-        """Hand one coalesced micro-batch to the least-loaded replica.
-        Host preprocessing, the async device launch, and the D2H sync all
-        happen on that replica's own threads (serving/replicas.py), so
-        this dispatcher only ever routes — a slow readback on one device
+    def _flush(self, key, reqs: List[_Request]) -> None:
+        """Hand one coalesced micro-batch to its tier's least-loaded
+        replica. Host preprocessing, the async device launch, and the D2H
+        sync all happen on that replica's own threads (serving/replicas.py),
+        so this dispatcher only ever routes — a slow readback on one device
         cannot delay coalescing or launches for the others. Requests whose
         deadline has already passed are dropped here with a counter, not
         computed: a response nobody is waiting for is pure wasted device
         time under exactly the overload that made it late."""
+        tier, bucket = key
         if not reqs:
             return
         now = time.perf_counter()
@@ -405,7 +509,7 @@ class DynamicBatcher:
         if not live:
             return
         try:
-            self._pool.dispatch(
+            self._pools[tier].dispatch(
                 bucket, live, queue_depth=self._requests.qsize()
             )
         except BaseException as err:

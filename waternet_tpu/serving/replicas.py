@@ -186,6 +186,7 @@ class _Replica:
                     padded_px=n_slots * bh * bw,
                     queue_depth=depth,
                     replica=self.index,
+                    tier=pool.tier,
                 )
                 self.inflight.put((out, reqs, t0))
             except BaseException as err:
@@ -238,7 +239,9 @@ class _Replica:
             for i, r in enumerate(reqs):
                 h, w = r.image.shape[:2]
                 r.future.set_result(arr[i, :h, :w])
-                pool.stats.record_latency(t_done - r.t_submit, replica=self.index)
+                pool.stats.record_latency(
+                    t_done - r.t_submit, replica=self.index, tier=pool.tier
+                )
             pool.stats.record_replica_busy(self.index, t_done - t0)
             self._done()
 
@@ -272,6 +275,7 @@ class ReplicaPool:
         max_inflight_per_replica: int = 2,
         stats: Optional[ServingStats] = None,
         warmup_verbose: bool = False,
+        tier: str = "quality",
     ):
         import jax
 
@@ -298,6 +302,12 @@ class ReplicaPool:
         self.max_inflight = int(max_inflight_per_replica)
         self.stats = stats if stats is not None else ServingStats()
         self.stats.set_replicas(n_replicas)
+        # Which serving tier this pool's batches/requests count under
+        # (docs/SERVING.md "Quality tiers"): "quality" for the PR-4/5
+        # teacher pipeline, "fast" for the CAN-student pool a tier-routing
+        # DynamicBatcher stacks next to it on the same devices.
+        self.tier = str(tier)
+        self.stats.declare_tier(self.tier)
         self._lock = threading.Lock()
         self._closed = False
         # A single replica keeps the engine's default placement (device

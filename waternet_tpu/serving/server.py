@@ -70,6 +70,7 @@ from waternet_tpu.serving.batcher import (
     DeadlineExpired,
     DynamicBatcher,
     QueueFull,
+    UnknownTier,
     resolve_ladder,
 )
 from waternet_tpu.serving.stats import ServingStats
@@ -168,12 +169,14 @@ class ServingServer:
         grace_sec: float = 30.0,
         min_deadline_ms: float = 0.0,
         stats: Optional[ServingStats] = None,
+        fast_engine=None,
     ):
         if admit_watermark is None:
             # Shed before QueueFull would fire: the watermark is the soft
             # limit with headroom for requests already racing past it.
             admit_watermark = max(1, (3 * max_queue) // 4)
         self.engine = engine
+        self.fast_engine = fast_engine
         self.ladder = ladder
         self.host = host
         self.port = int(port)
@@ -282,6 +285,7 @@ class ServingServer:
                     stats=self.stats,
                     replicas=self.replicas,
                     max_queue=self.max_queue,
+                    fast_engine=self.fast_engine,
                 )
 
             loop = asyncio.get_running_loop()
@@ -289,7 +293,9 @@ class ServingServer:
             self.ready.set()
             print(
                 f"waternet-serve: ready ({len(self.ladder)} buckets x "
-                f"{self.batcher.n_replicas} replicas warmed, batch "
+                f"{self.batcher.n_replicas} replicas x "
+                f"{len(self.batcher.tiers)} tiers "
+                f"[{', '.join(self.batcher.tiers)}] warmed, batch "
                 f"{self.batcher.max_batch})",
                 flush=True,
             )
@@ -472,6 +478,31 @@ class ServingServer:
                 extra=(("Retry-After", "1"),),
             )
 
+        # Tier routing (docs/SERVING.md "Quality tiers"): X-Tier selects
+        # the serving model per request; unknown names — and "fast" on a
+        # server started without --student-weights — are 400, loudly:
+        # a tier is a quality contract, not a routing hint.
+        tier = headers.get("x-tier", "quality").strip().lower()
+        if tier not in ("quality", "fast"):
+            return self._json(
+                writer,
+                400,
+                {
+                    "error": f"unknown tier {tier!r}",
+                    "tiers": list(self.batcher.tiers),
+                },
+            )
+        if tier not in self.batcher.tiers:
+            return self._json(
+                writer,
+                400,
+                {
+                    "error": "fast tier not configured on this server "
+                    "(start waternet-serve with --student-weights)",
+                    "tiers": list(self.batcher.tiers),
+                },
+            )
+
         # Deadline parse + up-front feasibility: a budget the server
         # already knows it cannot meet is refused before it queues.
         deadline = None
@@ -531,7 +562,9 @@ class ServingServer:
                     writer, 400, {"error": "body is not a decodable image"}
                 )
             try:
-                fut = self.batcher.submit(rgb, deadline=deadline)
+                fut = self.batcher.submit(rgb, deadline=deadline, tier=tier)
+            except UnknownTier as err:
+                return self._json(writer, 400, {"error": str(err)})
             except QueueFull as err:
                 return self._json(
                     writer,
@@ -697,6 +730,20 @@ def parse_args(argv=None):
         "(operators set it to their known serving floor; 0 disables).",
     )
     parser.add_argument(
+        "--student-weights", type=str, default=None,
+        help="CAN student checkpoint (a train.py --distill product): "
+        "enables the fast tier — requests with 'X-Tier: fast' are served "
+        "by the student (raw RGB in, no WB/GC/CLAHE anywhere) from its "
+        "own AOT-warmed executable grid. Without it, fast-tier requests "
+        "are refused with 400 (docs/SERVING.md 'Quality tiers').",
+    )
+    parser.add_argument(
+        "--student-quantize", action="store_true", default=False,
+        help="Serve the fast tier as static int8 (models/quant.py "
+        "quantize_can: MXU double-rate path; error bound vs the float "
+        "student pinned in tests). Requires --student-weights.",
+    )
+    parser.add_argument(
         "--device-preprocess", action="store_true", default=False,
         help="Run WB/GC/CLAHE on the accelerator (ops/masked.py).",
     )
@@ -721,15 +768,31 @@ def main(argv=None) -> int:
 
     from waternet_tpu.inference_engine import InferenceEngine
 
+    if args.student_quantize and not args.student_weights:
+        # Pure flag validation — fail before any engine is built.
+        raise SystemExit(
+            "--student-quantize needs --student-weights (there is no "
+            "student to quantize)"
+        )
     engine = InferenceEngine(
         weights=args.weights,
         device_preprocess=args.device_preprocess,
         dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
     )
+    fast_engine = None
+    if args.student_weights:
+        from waternet_tpu.inference_engine import StudentEngine
+
+        fast_engine = StudentEngine(
+            weights=args.student_weights,
+            dtype=jnp.bfloat16 if args.precision == "bf16" else jnp.float32,
+            quantize=args.student_quantize,
+        )
     ladder = resolve_ladder(args.serve_buckets)
     server = ServingServer(
         engine,
         ladder,
+        fast_engine=fast_engine,
         host=args.host,
         port=args.port,
         max_batch=args.max_batch,

@@ -58,7 +58,12 @@ class ServingStats:
     * **per-replica** occupancy / mean latency / busy seconds, plus the
       aggregate **images_per_sec** (requests completed over the
       first-dispatch -> last-completion span) and **load_imbalance**
-      (max over mean per-replica request count; 1.0 = perfectly even).
+      (max over mean per-replica request count; 1.0 = perfectly even);
+    * **per-tier** request/batch counters (``tiers``): quality vs fast
+      traffic split under per-request tier routing (docs/SERVING.md
+      "Quality tiers"). Every configured tier appears (a served-nothing
+      fast tier shows zeros); batchers without a fast engine report the
+      quality tier alone.
     """
 
     def __init__(self):
@@ -84,8 +89,20 @@ class ServingStats:
         self.depth_max = 0
         self.replicas = 1
         self._rep = {}  # index -> per-replica accumulator dict
+        # tier -> {requests, batches}: populated by declare_tier (each
+        # ReplicaPool registers its tier at construction) and by records;
+        # a bare stats object (ExactShapeBatcher, tests) grows its tier
+        # rows on first traffic.
+        self._tiers = {}
         self._t_first_batch = None
         self._t_last_done = None
+
+    def declare_tier(self, tier: str) -> None:
+        """Register a serving tier up front (a ReplicaPool does this at
+        construction) so an idle tier still reports zeros — absence
+        means 'not configured', not 'no traffic'."""
+        with self._lock:
+            self._tiers.setdefault(tier, {"requests": 0, "batches": 0})
 
     def set_replicas(self, n: int) -> None:
         """Declare the serving replica count (idle replicas must show up
@@ -102,12 +119,16 @@ class ServingStats:
             "lat_sum_s": 0.0, "busy_s": 0.0,
         }
 
-    def record_latency(self, seconds: float, replica: int = 0) -> None:
+    def record_latency(
+        self, seconds: float, replica: int = 0, tier: str = "quality"
+    ) -> None:
         with self._lock:
             self.requests += 1
             rep = self._rep.setdefault(replica, self._new_rep())
             rep["requests"] += 1
             rep["lat_sum_s"] += seconds
+            t = self._tiers.setdefault(tier, {"requests": 0, "batches": 0})
+            t["requests"] += 1
             self._t_last_done = time.perf_counter()
             if len(self._latencies_s) < LATENCY_RESERVOIR:
                 self._latencies_s.append(seconds)
@@ -121,10 +142,12 @@ class ServingStats:
 
     def record_batch(
         self, n_real: int, n_slots: int, real_px: int, padded_px: int,
-        queue_depth: int = 0, replica: int = 0,
+        queue_depth: int = 0, replica: int = 0, tier: str = "quality",
     ) -> None:
         with self._lock:
             self.batches += 1
+            t = self._tiers.setdefault(tier, {"requests": 0, "batches": 0})
+            t["batches"] += 1
             self.real_slots += n_real
             self.total_slots += n_slots
             self.real_px += real_px
@@ -255,6 +278,7 @@ class ServingStats:
             shed = self.shed
             expired = self.deadline_expired
             probe = self.queue_depth_probe
+            tiers = {name: dict(c) for name, c in self._tiers.items()}
         return {
             "requests": requests,
             "batches": batches,
@@ -271,6 +295,7 @@ class ServingStats:
             "replicas": replicas,
             "images_per_sec": round(self.images_per_sec(), 2),
             "load_imbalance": round(self.load_imbalance(), 3),
+            "tiers": tiers,
             "per_replica": self.per_replica(),
         }
 

@@ -162,6 +162,20 @@ class TrainConfig:
     # (UIEB-800 at 112x112 bf16: ~320 MB). Requires precache_histeq (same
     # dihedral machinery). Default off pending the hardware A/B.
     precache_vgg_ref: bool = False
+    # Distillation mode (the fast serving tier, docs/SERVING.md "Quality
+    # tiers"): train a compact CAN student (models/can.py) that maps raw
+    # RGB directly to the FULL quality pipeline's output. The trained
+    # model becomes the student; the frozen WaterNet teacher runs in-step
+    # under stop_gradient on the same preprocessed inputs the batch
+    # already carries (the WB/GC/CLAHE planes every non-distill step
+    # computes anyway become teacher inputs), and the ground-truth ref is
+    # REPLACED by the teacher output in every loss and metric — val
+    # ssim/psnr read as student-vs-teacher fidelity, which is what the
+    # tier-1 distillation pin asserts. Rides the pipeline, device-cache,
+    # resilience, and checkpoint machinery unchanged.
+    distill: bool = False
+    student_width: int = 24
+    student_depth: int = 7
 
     @property
     def dtype(self):
@@ -201,9 +215,33 @@ class TrainingEngine:
         params: Optional[dict] = None,
         vgg_params: Optional[dict] = None,
         mesh=None,
+        teacher_params: Optional[dict] = None,
     ):
         self.config = config
-        self.model = WaterNet(dtype=config.dtype)
+        if config.distill:
+            from waternet_tpu.models import CANStudent
+
+            if teacher_params is None:
+                raise ValueError(
+                    "distillation needs frozen teacher weights — pass "
+                    "teacher_params (CLI: --teacher-weights, or the "
+                    "standard weight resolution)"
+                )
+            if config.spatial_shards > 1:
+                raise ValueError(
+                    "distillation supports data parallelism only for now "
+                    "(the student's dilated convs would need 64-row halos)"
+                )
+            # The TRAINED model is the student; the teacher is a frozen
+            # constant of the loss, never part of the optimizer state.
+            self.model = CANStudent(
+                width=config.student_width, depth=config.student_depth,
+                dtype=config.dtype,
+            )
+            self.teacher = WaterNet(dtype=config.dtype)
+        else:
+            self.model = WaterNet(dtype=config.dtype)
+            self.teacher = None
         self.vgg = VGG19Features(dtype=config.dtype)
         if mesh is None:
             mesh = make_mesh(n_spatial=config.spatial_shards)
@@ -212,15 +250,23 @@ class TrainingEngine:
 
         if params is None:
             zeros = jnp.zeros((1, 32, 32, 3), jnp.float32)
-            params = self.model.init(
-                jax.random.PRNGKey(config.seed), zeros, zeros, zeros, zeros
-            )
+            if config.distill:
+                params = self.model.init(jax.random.PRNGKey(config.seed), zeros)
+            else:
+                params = self.model.init(
+                    jax.random.PRNGKey(config.seed), zeros, zeros, zeros, zeros
+                )
         if vgg_params is None and config.perceptual_weight != 0.0:
             from waternet_tpu.models.vgg import init_vgg_params
 
             vgg_params = init_vgg_params(dtype=config.dtype)
 
         rep = replicated(self.mesh)
+        self.teacher_params = (
+            jax.device_put(teacher_params, rep)
+            if teacher_params is not None and config.distill
+            else None
+        )
         # ~80 MB of replicated VGG HBM; skipped entirely when the
         # perceptual term is off (the step never applies it).
         self.vgg_params = (
@@ -286,11 +332,28 @@ class TrainingEngine:
         )
 
     def _losses_and_out(self, params, x, wbn, hen, gcn, refn, mask, ref_feats=None):
-        out = self.model.apply(params, x, wbn, hen, gcn)
+        if self.config.distill:
+            # Frozen teacher: the full quality pipeline's output (the
+            # batch's WB/GC/CLAHE planes are exactly the teacher's
+            # enhanced-variant inputs) replaces the ground-truth ref as
+            # the regression target for every loss AND metric below —
+            # val ssim/psnr read as student-vs-teacher fidelity.
+            refn = jax.lax.stop_gradient(
+                self.teacher.apply(self.teacher_params, x, wbn, hen, gcn)
+            )
+            ref_feats = None  # precached vgg(ref) targets the wrong image
+            out = self.model.apply(params, x)
+        else:
+            out = self.model.apply(params, x, wbn, hen, gcn)
         mse = mse_255(out, refn, mask)
+        aux = {"mse": mse, "perceptual_loss": jnp.zeros(())}
+        if self.config.distill:
+            # Hand the effective target to _metrics: distillation's
+            # ssim/psnr are student-vs-teacher, not student-vs-ref.
+            aux["target"] = refn
         if self.config.perceptual_weight == 0.0:
             # VGG dominates step FLOPs; skip it entirely when unweighted.
-            return mse, (out, {"mse": mse, "perceptual_loss": jnp.zeros(())})
+            return mse, (out, aux)
         perc = perceptual_loss(
             self.vgg, self.vgg_params,
             self._unshard_spatial(out), self._unshard_spatial(refn),
@@ -302,9 +365,11 @@ class TrainingEngine:
             ),
         )
         loss = self.config.perceptual_weight * perc + mse
-        return loss, (out, {"mse": mse, "perceptual_loss": perc})
+        aux["perceptual_loss"] = perc
+        return loss, (out, aux)
 
     def _metrics(self, out, refn, aux, mask, loss=None):
+        refn = aux.get("target", refn)
         m = {
             "mse": aux["mse"],
             "ssim": ssim_fn(out, refn, mask=mask),
@@ -672,6 +737,17 @@ class TrainingEngine:
         are additionally hoisted out of the step into precomputed caches —
         still bit-identical (see TrainConfig.precache_histeq).
         """
+        if self.config.precache_vgg_ref and self.config.distill:
+            # The precached table holds vgg(ground-truth ref); the
+            # distillation target is the teacher OUTPUT, whose features
+            # must be computed from the in-step teacher forward —
+            # silently gathering the wrong features would train against
+            # the wrong target.
+            raise ValueError(
+                "precache_vgg_ref is incompatible with distill: the "
+                "distillation target is the teacher output, not the "
+                "ground-truth ref the table was built from"
+            )
         if self.config.precache_vgg_ref and not (
             self.config.precache_histeq
             and not self.config.host_preprocess
