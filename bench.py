@@ -67,6 +67,15 @@ throughput over real sockets, the unloaded and loaded p99, and the
 admission watermark — with total request accounting (``accounted``)
 pinning that nothing is silently dropped.
 
+``--config serve_chaos`` measures fault isolation under load
+(docs/SERVING.md "Fault isolation"): the same closed-loop HTTP workload
+against a supervised 2-replica, two-tier server while one replica is
+crashed and another hung mid-run on deterministic fault-plan cues —
+``chaos_images_per_sec`` is the sustained throughput THROUGH the
+faults, with recovery time (quarantine -> re-warm -> reintegrate),
+retried / downgraded / shed counts, and an ``accounted`` cross-check of
+the client-side ledger against the server's ``/stats``.
+
 ``--config tiers`` measures the per-request quality-tier A/B
 (docs/SERVING.md "Quality tiers"): one tier-routing batcher serves the
 same mixed-resolution stream through the full WaterNet pipeline and then
@@ -548,6 +557,7 @@ def bench_serving_http(
         and summary["deadline_expired"]
         == sum(p["deadline_expired"] for p in phases)
         and all(p["errors"] == 0 for p in phases)
+        and all(p["conn_reset"] == 0 for p in phases)
     )
     return {
         "metric": "http_images_per_sec",
@@ -571,6 +581,161 @@ def bench_serving_http(
         "warmup_sec": round(warmup_s, 1),
         "concurrency": concurrency,
         "requests_per_phase": n_req,
+        "n_images": n_images,
+        "max_batch": max_batch,
+    }
+
+
+def bench_serving_chaos(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+    concurrency=None, requests=None, watchdog_sec=5.0,
+    fault_spec="replica_crash@2,replica_hang@5",
+):
+    """Fault-isolation chaos bench (docs/SERVING.md "Fault isolation"):
+    a supervised two-tier server on min(2, local devices) replicas per
+    tier, driven by the closed-loop load generator with brown-out opt-in
+    traffic, while a deterministic fault plan crashes one replica's
+    batch and hangs another mid-run. The contract line reports sustained
+    throughput THROUGH the faults (``chaos_images_per_sec``), the
+    quarantine -> re-warm -> reintegrate recovery time, the retried /
+    downgraded / shed counts, and ``accounted`` — the client-side ledger
+    (ok / shed / deadline / rejected / conn_reset / errors / downgraded)
+    cross-checked against the server's ``/stats``, so a silently dropped
+    or double-served request reads ``accounted: false``.
+
+    ``watchdog_sec`` must clear the workload's real worst-case batch
+    latency with margin (first executions on a cold, contended CPU smoke
+    host run hundreds of ms): a watchdog tighter than the p100 batch
+    time quarantines HEALTHY replicas and the chaos line measures the
+    false-positive spiral instead of the injected faults.
+
+    The fast tier is a fresh CAN-student init (throughput and the
+    isolation machinery are weight-independent; point
+    WATERNET_STUDENT_WEIGHTS at a distilled checkpoint for real
+    downgrade fidelity). The hang is released at the end of the run
+    (the fault plan's release latch), so every worker thread joins.
+    """
+    import cv2
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.inference_engine import InferenceEngine, StudentEngine
+    from waternet_tpu.models import CANStudent
+    from waternet_tpu.resilience import faults
+    from waternet_tpu.serving import SupervisionConfig, derive_buckets
+    from waternet_tpu.serving.loadgen import run_load
+    from waternet_tpu.serving.server import ServingServer
+
+    n_images, max_batch, max_buckets = _serving_env_defaults(
+        n_images, max_batch, max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+    concurrency = (
+        _env_int("WATERNET_BENCH_SERVE_CONCURRENCY", 2 * max_batch)
+        if concurrency is None else concurrency
+    )
+    n_req = (
+        _env_int("WATERNET_BENCH_SERVE_REQUESTS", 2 * n_images)
+        if requests is None else requests
+    )
+    replicas = min(2, len(jax.local_devices()))
+
+    params = _serving_params()
+    student_params = CANStudent().init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16, 16, 3), jnp.float32)
+    )
+    images, shapes = _serving_population(n_images, base)
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+    payloads = [
+        cv2.imencode(".png", im[:, :, ::-1])[1].tobytes() for im in images
+    ]
+
+    server = ServingServer(
+        InferenceEngine(params=params), ladder,
+        max_batch=max_batch, max_wait_ms=5.0, replicas=replicas,
+        max_queue=8 * max_batch, admit_watermark=4 * max_batch,
+        fast_engine=StudentEngine(params=student_params),
+        # Closed-loop depth is bounded by `concurrency`, so the brown-out
+        # watermark must sit BELOW it or the downgrade arm this line
+        # reports could never fire: at concurrency/2, the hang window
+        # (one replica wedged, its queue backing up) pushes the quality
+        # backlog past the watermark and opt-in traffic downgrades.
+        downgrade_watermark=max(2, concurrency // 2),
+        supervision=SupervisionConfig(
+            watchdog_sec=watchdog_sec,
+            rewarm_backoff_sec=0.05,
+            scan_interval_sec=0.01,
+        ),
+    )
+    t0 = time.perf_counter()
+    server.start_background()
+    server.wait_ready()
+    warmup_s = time.perf_counter() - t0
+    faults.install(faults.FaultPlan.parse(fault_spec))
+    try:
+        t0 = time.perf_counter()
+        loaded = run_load(
+            server.url, payloads, concurrency=concurrency, total=n_req,
+            tier="quality", allow_downgrade=True,
+        )
+        chaos_s = time.perf_counter() - t0
+    finally:
+        # Release the injected hang so the retired launch thread wakes,
+        # discards its aborted batch, and joins at close.
+        faults.clear()
+    # Recovery: wait until every quarantined replica reintegrated (the
+    # devices aren't actually sick — a real pool recovers in one probe).
+    deadline = time.monotonic() + 60.0
+    recovered = False
+    while time.monotonic() < deadline:
+        s = server.stats.summary()
+        if s["reintegrations"] >= s["quarantines"]:
+            recovered = True
+            break
+        time.sleep(0.05)
+    server.request_drain()
+    server.join()
+    summary = server.stats.summary()
+
+    accounted = (
+        summary["requests"] == loaded["ok"]
+        and summary["shed_count"] == loaded["shed"]
+        # Server downgrades count at ROUTING time, the client's at
+        # delivery (200 + X-Tier-Served): a downgraded request that then
+        # failed (retry exhaustion during the chaos window) legitimately
+        # shows server-side only — never the other way around.
+        and summary["downgraded"] >= loaded["downgraded"]
+        and summary["deadline_expired"] == loaded["deadline_expired"]
+        and loaded["errors"] == 0
+        and loaded["conn_reset"] == 0
+    )
+    return {
+        "metric": "chaos_images_per_sec",
+        "value": round(loaded["ok"] / chaos_s, 2) if chaos_s else 0.0,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "replicas": replicas,
+        "faults": fault_spec,
+        "watchdog_sec": watchdog_sec,
+        "quarantines": summary["quarantines"],
+        "reintegrations": summary["reintegrations"],
+        "recovered": bool(recovered),
+        "recovery_sec": summary["recovery_sec_max"],
+        "retried": summary["retried"],
+        "downgraded": summary["downgraded"],
+        "nan_outputs": summary["nan_outputs"],
+        "shed_count": summary["shed_count"],
+        "deadline_expired": summary["deadline_expired"],
+        "conn_reset": loaded["conn_reset"],
+        "errors": loaded["errors"],
+        "accounted": bool(accounted),
+        "replica_health": summary["replica_health"],
+        "p99_ms": loaded["latency_ms"]["p99"],
+        "buckets": ladder.describe(),
+        "compiles": summary["compiles"],
+        "warmup_sec": round(warmup_s, 1),
+        "concurrency": concurrency,
+        "requests": n_req,
         "n_images": n_images,
         "max_batch": max_batch,
     }
@@ -1302,7 +1467,7 @@ def main():
     parser.add_argument(
         "--config",
         choices=["train", "video", "serve", "serve_multi", "serve_http",
-                 "tiers"],
+                 "serve_chaos", "tiers"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
@@ -1311,6 +1476,9 @@ def main():
         "(replica-pool scale-out: N replicas vs 1 on the same stream), "
         "serve_http (the HTTP front door end-to-end over real "
         "sockets: throughput, p99, and shed rate at 2x offered load), "
+        "serve_chaos (closed-loop throughput with one replica crashed "
+        "and one hung mid-run: recovery time, retry/downgrade/shed "
+        "accounting — docs/SERVING.md 'Fault isolation'), "
         "or tiers (quality vs fast CAN-student A/B under per-request "
         "tier routing: throughput, FLOP ratio, SSIM-vs-teacher, int8 "
         "arm — docs/SERVING.md 'Quality tiers')",
@@ -1329,6 +1497,7 @@ def main():
         "serve": "mixed_res_dir_images_per_sec",
         "serve_multi": "mixed_res_dir_images_per_sec_multidev",
         "serve_http": "http_images_per_sec",
+        "serve_chaos": "chaos_images_per_sec",
         "tiers": "fast_tier_images_per_sec",
     }.get(args.config, "uieb_train_images_per_sec_per_chip")
 
@@ -1416,6 +1585,10 @@ def main():
 
     if args.config == "serve_http":
         print(json.dumps(bench_serving_http()))
+        return
+
+    if args.config == "serve_chaos":
+        print(json.dumps(bench_serving_chaos()))
         return
 
     if args.config == "tiers":

@@ -199,6 +199,16 @@ def parse_args(argv=None):
         "train.py --distill product).",
     )
     parser.add_argument(
+        "--allow-downgrade",
+        action="store_true",
+        default=False,
+        help="(Optional) --serve-url only: opt into brown-out downgrades "
+        "(X-Tier-Allow-Downgrade: 1) — a saturated server may serve "
+        "quality requests from the fast tier instead of shedding them; "
+        "every downgrade is reported at the end (docs/SERVING.md 'Fault "
+        "isolation').",
+    )
+    parser.add_argument(
         "--serve-url",
         type=str,
         default=None,
@@ -436,7 +446,7 @@ def run_images_bucketed(
 
 def run_images_remote(
     url: str, paths, savedir: Path, show_split: bool, max_retries: int = 10,
-    tier: str = "quality",
+    tier: str = "quality", allow_downgrade: bool = False,
 ):
     """Thin client for the HTTP front door (docs/SERVING.md "Front
     door"): POST each image file's bytes to ``<url>/enhance`` and write
@@ -456,6 +466,13 @@ def run_images_remote(
     "Quality tiers"); it is validated HERE too — an unknown name never
     reaches the wire (and the server's own 400 is pinned in tests), so a
     typo'd tier can't silently serve the wrong model.
+
+    ``allow_downgrade`` sets ``X-Tier-Allow-Downgrade: 1`` — the
+    brown-out opt-in (docs/SERVING.md "Fault isolation"): a saturated
+    server may serve quality requests from the fast tier instead of
+    shedding them. Responses served by a different tier than requested
+    (the ``X-Tier-Served`` header) are counted and reported at the end
+    — the downgrade is consented-to, never silent.
     """
     import http.client
     import time as _time
@@ -468,6 +485,13 @@ def run_images_remote(
         raise SystemExit(
             f"unknown tier {tier!r}: valid tiers are 'quality' and 'fast'"
         )
+    headers = {
+        "Content-Type": "application/octet-stream",
+        "X-Tier": tier,
+    }
+    if allow_downgrade:
+        headers["X-Tier-Allow-Downgrade"] = "1"
+    downgraded = 0
     u = urlparse(url)
     conn = http.client.HTTPConnection(
         u.hostname, u.port or 80, timeout=300
@@ -480,13 +504,7 @@ def run_images_remote(
                 continue
             data = path.read_bytes()
             for attempt in range(max_retries + 1):
-                conn.request(
-                    "POST", "/enhance", body=data,
-                    headers={
-                        "Content-Type": "application/octet-stream",
-                        "X-Tier": tier,
-                    },
-                )
+                conn.request("POST", "/enhance", body=data, headers=headers)
                 resp = conn.getresponse()
                 body = resp.read()
                 if resp.status != 429:
@@ -498,6 +516,9 @@ def run_images_remote(
                     f"server returned {resp.status} for {path.name}: "
                     f"{body[:200]!r}"
                 )
+            served = resp.getheader("X-Tier-Served", tier)
+            if served != tier:
+                downgraded += 1
             out_bgr = cv2.imdecode(
                 np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR
             )
@@ -506,6 +527,11 @@ def run_images_remote(
             cv2.imwrite(str(savedir / path.name), out)
     finally:
         conn.close()
+    if downgraded:
+        print(
+            f"{downgraded} request(s) served by the fast tier under "
+            "brown-out (you opted in with --allow-downgrade)"
+        )
 
 
 def run_video(
@@ -578,10 +604,21 @@ def main(argv=None):
         print(f"Total images/videos: {len(files)}")
         savedir = next_run_dir(Path(__file__).parent / "output", args.name)
         run_images_remote(
-            args.serve_url, files, savedir, args.show_split, tier=args.tier
+            args.serve_url, files, savedir, args.show_split, tier=args.tier,
+            allow_downgrade=args.allow_downgrade,
         )
         print(f"Saved output to {savedir}!")
         return
+    if args.allow_downgrade:
+        # Loud, like every other mode-incompatible flag: brown-out is a
+        # server-side decision — local serving has no saturation to
+        # degrade under, and silently ignoring the opt-in would let a
+        # user believe they enabled behavior that cannot exist here.
+        raise SystemExit(
+            "--allow-downgrade is a --serve-url (thin-client) option: "
+            "brown-out downgrades are the SERVER's saturation response "
+            "(docs/SERVING.md 'Fault isolation')"
+        )
     from waternet_tpu.utils.platform import ensure_platform
 
     ensure_platform()

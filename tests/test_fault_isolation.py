@@ -1,0 +1,797 @@
+"""Serving fault isolation (docs/SERVING.md "Fault isolation"): the
+replica health state machine under the deterministic serving fault kinds
+(``replica_crash@K`` / ``replica_hang@K`` / ``nan_output@K``), transparent
+re-dispatch with byte-identical results and no stranded futures, the
+output sanity guard, brown-out tier degradation (opt-in only), the
+degraded/unhealthy ``/healthz`` states, the loud wedged-thread report at
+close, the loadgen reset-vs-hard-error accounting, and the
+``serve_chaos`` bench contract line.
+
+The acceptance pins (ISSUE 9): under ``replica_crash@K`` and
+``replica_hang@K`` on an N>=2 pool every submitted request resolves,
+results are byte-identical to a healthy 1-replica run, the sick replica
+is quarantined and reintegrated with zero unaccounted jit-cache growth,
+and a quality request with downgrade opt-in under induced saturation
+returns a fast-tier result while a non-opt-in request is shed with 429.
+"""
+
+import http.client
+import json
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from waternet_tpu.resilience import faults
+from waternet_tpu.serving import (
+    BucketLadder,
+    DynamicBatcher,
+    SupervisionConfig,
+)
+from waternet_tpu.serving.loadgen import run_load
+from waternet_tpu.utils.tensor import ten2arr
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.distill_fixture import FIXTURE_DIR  # noqa: E402
+
+BUCKET = (32, 32)
+
+
+def _sup(**kw):
+    """Test-speed supervision: tight scan/backoff so a quarantine cycle
+    completes in milliseconds, production-shaped otherwise."""
+    kw.setdefault("scan_interval_sec", 0.005)
+    kw.setdefault("rewarm_backoff_sec", 0.01)
+    return SupervisionConfig(**kw)
+
+
+def _wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def params():
+    from waternet_tpu.models import WaterNet
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+
+@pytest.fixture(scope="module")
+def student_params():
+    from waternet_tpu.hub import resolve_weights
+
+    return resolve_weights(str(FIXTURE_DIR / "student.npz"))
+
+
+@pytest.fixture(scope="module")
+def mixed_images(rng):
+    """Six images in one 32x32 bucket class (so streams coalesce into a
+    couple of launches — fault ordinals stay easy to reason about)."""
+    return [
+        np.asarray(rng.integers(0, 256, (24 + i, 26, 3)), dtype=np.uint8)
+        for i in range(6)
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_fault_plan():
+    """Every test leaves the global fault plan cleared (clearing also
+    releases any armed replica_hang latch, so wedged threads wake and the
+    conftest thread-leak guard stays authoritative)."""
+    yield
+    faults.clear()
+
+
+def _healthy_reference(params, images, tier_engine=None, max_batch=4):
+    """Byte-identity oracle: the same stream through a fault-free
+    1-replica batcher."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    engine = InferenceEngine(params=params)
+    with DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=max_batch, max_wait_ms=5
+    ) as b:
+        return b.map_ordered(images)
+
+
+# ---------------------------------------------------------------------------
+# Tentpole pins: crash / hang / nan_output isolation, byte-identical retries
+# ---------------------------------------------------------------------------
+
+
+def test_replica_crash_quarantine_retry_byte_identity(
+    params, mixed_images, compile_sentinel
+):
+    """replica_crash@K on a 2-replica pool: the poisoned batch's requests
+    re-dispatch onto the surviving replica (every future resolves,
+    byte-identical to a healthy 1-replica run), the sick replica walks
+    suspect -> quarantined -> rewarming -> healthy, and the whole cycle
+    — retries AND the re-warm probe — grows no jit cache (executables
+    are reused, sentinel-pinned)."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    ref = _healthy_reference(params, mixed_images)
+
+    engine = InferenceEngine(params=params)
+    b = DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=4, max_wait_ms=5,
+        replicas=2, supervision=_sup(),
+    )
+    compile_sentinel.arm(forward=engine._forward)
+    try:
+        faults.install(faults.FaultPlan.parse("replica_crash@1"))
+        outs = b.map_ordered(mixed_images)
+        faults.clear()
+        for a, r in zip(outs, ref):
+            np.testing.assert_array_equal(a, r)
+        summary = b.stats.summary()
+        assert summary["requests"] == len(mixed_images)
+        assert summary["retried"] >= 1
+        assert summary["quarantines"] >= 1
+        # The replica re-warms through its EXISTING executables and
+        # reintegrates; recovery is observable in stats and health.
+        _wait_for(
+            lambda: b.stats.summary()["reintegrations"]
+            >= b.stats.summary()["quarantines"],
+            what="reintegration",
+        )
+        _wait_for(
+            lambda: all(
+                s == "healthy" for s in b.health()["quality"].values()
+            ),
+            what="all replicas healthy again",
+        )
+        assert b.stats.summary()["recovery_sec_max"] > 0.0
+        final = b.stats.summary()
+    finally:
+        b.close()
+    compile_sentinel.check()  # zero jit growth across crash + re-warm
+    assert final["compiles"] == 2  # 1 bucket x 2 replicas, warmup only
+    assert final["fallback_native_shapes"] == 0
+
+
+def test_replica_hang_watchdog_redispatch_and_reintegrate(
+    params, mixed_images
+):
+    """replica_hang@K: the wedged launch neither completes nor raises —
+    the watchdog declares the batch failed, quarantines the replica with
+    a FRESH worker generation (the wedged thread cannot be interrupted),
+    re-dispatches the stranded requests (byte-identical results, no
+    stranded futures), and reintegrates after a probe. Releasing the
+    hang wakes the retired thread, which discards its aborted batch —
+    nothing is delivered twice."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    ref = _healthy_reference(params, mixed_images)
+
+    engine = InferenceEngine(params=params)
+    # Watchdog sized ABOVE the workload's real worst-case batch latency
+    # (cold first executions on a loaded suite host run ~0.5 s): a
+    # tighter watchdog quarantines the HEALTHY replica serving the
+    # re-dispatched batch and the test measures false positives, not
+    # the injected hang.
+    b = DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=len(mixed_images),
+        max_wait_ms=5, replicas=2,
+        supervision=_sup(watchdog_sec=2.0),
+    )
+    try:
+        faults.install(faults.FaultPlan.parse("replica_hang@1"))
+        t0 = time.perf_counter()
+        outs = b.map_ordered(mixed_images)  # one batch -> the hung launch
+        waited = time.perf_counter() - t0
+        for a, r in zip(outs, ref):
+            np.testing.assert_array_equal(a, r)
+        # The watchdog, not luck, resolved this: the results arrived
+        # after the deadline fired but far before any human timeout.
+        assert waited >= 1.0, "hang did not actually hold the batch"
+        summary = b.stats.summary()
+        assert summary["retried"] >= len(mixed_images)
+        assert summary["quarantines"] >= 1
+        faults.clear()  # release the wedged generation so it can retire
+        _wait_for(
+            lambda: b.stats.summary()["reintegrations"] >= 1,
+            what="reintegration after hang",
+        )
+        _wait_for(
+            lambda: all(
+                s == "healthy" for s in b.health()["quality"].values()
+            ),
+            what="hung replica healthy again",
+        )
+    finally:
+        b.close()
+    assert b._pool.leaked_threads == []  # released hang -> clean join
+
+
+def test_nan_output_guard_detects_and_retries(params, mixed_images):
+    """nan_output@K poisons the K-th completed batch's host array after
+    D2H: the output sanity guard rejects it (counted), the batch retries
+    on a surviving replica, and the delivered results are byte-identical
+    to a healthy run — corrupt output never reaches a client."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    ref = _healthy_reference(params, mixed_images)
+
+    engine = InferenceEngine(params=params)
+    b = DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=len(mixed_images),
+        max_wait_ms=5, replicas=2, supervision=_sup(),
+    )
+    try:
+        faults.install(faults.FaultPlan.parse("nan_output@1"))
+        outs = b.map_ordered(mixed_images)
+        faults.clear()
+        for a, r in zip(outs, ref):
+            np.testing.assert_array_equal(a, r)
+        summary = b.stats.summary()
+        assert summary["nan_outputs"] == 1
+        assert summary["retried"] >= len(mixed_images)
+        assert summary["quarantines"] >= 1
+        _wait_for(
+            lambda: b.stats.summary()["reintegrations"]
+            >= b.stats.summary()["quarantines"],
+            what="reintegration after bad output",
+        )
+    finally:
+        b.close()
+
+
+def test_output_guard_semantics_unit():
+    """The guard's exact decision table: non-finite always fails;
+    all-zero output fails ONLY when some input pixel was nonzero — a
+    legitimately all-black frame enhancing to black is not corruption
+    and must never quarantine a healthy replica."""
+    import types
+
+    from waternet_tpu.serving.replicas import _output_ok
+
+    black = types.SimpleNamespace(image=np.zeros((4, 4, 3), np.uint8))
+    lit = types.SimpleNamespace(image=np.full((4, 4, 3), 7, np.uint8))
+    zeros = np.zeros((1, 8, 8, 3), np.float32)
+    assert _output_ok(zeros, [black])  # black in, black out: fine
+    assert not _output_ok(zeros, [lit])  # lit in, black out: corruption
+    assert not _output_ok(zeros, [black, lit])  # any lit input counts
+    nans = np.full((1, 8, 8, 3), np.nan, np.float32)
+    assert not _output_ok(nans, [black])  # non-finite always fails
+    ok = np.full((1, 8, 8, 3), 0.5, np.float32)
+    assert _output_ok(ok, [lit])
+
+
+def test_output_guard_off_delivers_unchecked(params, rng):
+    """output_guard=False: the poisoned batch sails through (zeroed
+    uint8 canvas delivered) — pinning that the guard, not coincidence,
+    is what test_nan_output_guard_detects_and_retries exercises."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    img = np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8)
+    engine = InferenceEngine(params=params)
+    b = DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=2, max_wait_ms=5,
+        supervision=_sup(output_guard=False),
+    )
+    try:
+        faults.install(faults.FaultPlan.parse("nan_output@1"))
+        (out,) = b.map_ordered([img])
+        faults.clear()
+        # Delivered unchecked (whatever the NaN canvas casts to) — the
+        # point is that nothing was counted and nothing retried.
+        assert out.shape == img.shape
+        assert b.stats.summary()["nan_outputs"] == 0
+        assert b.stats.summary()["retried"] == 0
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: tier isolation — one tier's sick replica never disturbs the other
+# ---------------------------------------------------------------------------
+
+
+def test_tier_isolation_under_replica_crash(
+    params, student_params, mixed_images
+):
+    """A fast-tier replica crash must not disturb quality-tier traffic,
+    and vice versa: each pool has its own replicas, supervisor, and
+    retry path — pinned byte-identical in both directions on 2-replica
+    pools under replica_crash@K."""
+    from waternet_tpu.inference_engine import InferenceEngine, StudentEngine
+
+    fast = StudentEngine(params=student_params)
+    b = DynamicBatcher(
+        InferenceEngine(params=params), BucketLadder([BUCKET]), max_batch=4,
+        max_wait_ms=5, replicas=2, fast_engine=fast, supervision=_sup(),
+    )
+    try:
+        # Fault-free references through the SAME batcher.
+        ref_q = b.map_ordered(mixed_images)
+        ref_f = b.map_ordered(mixed_images, tier="fast")
+
+        # Crash lands on the FAST pool (its launch is ordinal 1 after
+        # install resets the counter): fast retries, quality untouched.
+        faults.install(faults.FaultPlan.parse("replica_crash@1"))
+        outs_f = b.map_ordered(mixed_images, tier="fast")
+        outs_q = b.map_ordered(mixed_images)
+        faults.clear()
+        for a, r in zip(outs_f, ref_f):
+            np.testing.assert_array_equal(a, r)
+        for a, r in zip(outs_q, ref_q):
+            np.testing.assert_array_equal(a, r)
+        assert all(
+            s == "healthy" for s in b.health()["quality"].values()
+        ), "a fast-tier crash leaked into the quality pool's health"
+        retried_after_fast = b.stats.summary()["retried"]
+        assert retried_after_fast >= 1
+
+        # And the other direction: crash on the QUALITY pool.
+        faults.install(faults.FaultPlan.parse("replica_crash@1"))
+        outs_q2 = b.map_ordered(mixed_images)
+        outs_f2 = b.map_ordered(mixed_images, tier="fast")
+        faults.clear()
+        for a, r in zip(outs_q2, ref_q):
+            np.testing.assert_array_equal(a, r)
+        for a, r in zip(outs_f2, ref_f):
+            np.testing.assert_array_equal(a, r)
+        assert b.stats.summary()["retried"] > retried_after_fast
+        _wait_for(
+            lambda: b.stats.summary()["reintegrations"]
+            >= b.stats.summary()["quarantines"],
+            what="both pools recovered",
+        )
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Brown-out degradation: opt-in only, counted, byte-exact fast-tier result
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_downgrade_batcher_level(params, student_params, rng):
+    """Past the downgrade watermark an OPTED-IN quality request is served
+    by the fast tier (byte-identical to the student's offline output,
+    counted in stats.downgraded); a request that did not opt in — or one
+    submitted below the watermark — keeps the quality tier."""
+    from waternet_tpu.inference_engine import InferenceEngine, StudentEngine
+
+    img = np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8)
+    fast = StudentEngine(params=student_params)
+    b = DynamicBatcher(
+        InferenceEngine(params=params), BucketLadder([BUCKET]), max_batch=8,
+        max_wait_ms=10_000, fast_engine=fast, supervision=_sup(),
+        downgrade_watermark=2,
+    )
+    try:
+        # Below the watermark: opt-in changes nothing.
+        early = b.submit(img, tier="quality", allow_downgrade=True)
+        assert early.tier == "quality"
+        held = [b.submit(img) for _ in range(2)]  # backlog now >= 2
+        opted = b.submit(img, tier="quality", allow_downgrade=True)
+        plain = b.submit(img, tier="quality")
+        assert opted.tier == "fast"  # brown-out routed it
+        assert plain.tier == "quality"  # no opt-in -> never downgraded
+        b.drain()
+        h, w = img.shape[:2]
+        offline_fast = ten2arr(
+            fast.enhance_padded_async([img], BUCKET, n_slots=8)
+        )[0, :h, :w]
+        np.testing.assert_array_equal(opted.result(timeout=60), offline_fast)
+        for f in (early, plain, *held):
+            assert f.result(timeout=60).shape == img.shape
+        summary = b.stats.summary()
+        assert summary["downgraded"] == 1
+        assert summary["tiers"]["fast"]["requests"] == 1
+    finally:
+        b.close()
+
+
+def test_brownout_http_downgrade_opt_in_vs_shed(
+    params, student_params, rng, monkeypatch
+):
+    """The acceptance pin over HTTP, saturation induced deterministically
+    via WATERNET_FAULTS: with the quality queue held at the admit
+    watermark by a slow_replica stall, an opted-in request
+    (X-Tier-Allow-Downgrade: 1) returns a FAST-tier result (200,
+    X-Tier-Served: fast, byte-identical to the offline student) while a
+    non-opt-in request is shed with 429 — and every held request still
+    completes."""
+    import cv2
+
+    from waternet_tpu.inference_engine import InferenceEngine, StudentEngine
+    from waternet_tpu.serving.server import ServingServer
+
+    fast = StudentEngine(params=student_params)
+    srv = ServingServer(
+        InferenceEngine(params=params), BucketLadder([BUCKET]), max_batch=8,
+        max_wait_ms=30, replicas=1, max_queue=64, admit_watermark=3,
+        fast_engine=fast, supervision=_sup(),
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        port = srv.bound_port
+        bgr = np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8)
+        ok, buf = cv2.imencode(".png", bgr)
+        assert ok
+        payload = buf.tobytes()
+
+        def post(headers=None, out=None, key=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            try:
+                conn.request(
+                    "POST", "/enhance", body=payload, headers=headers or {}
+                )
+                resp = conn.getresponse()
+                result = (resp.status, dict(resp.getheaders()), resp.read())
+                if out is not None:
+                    out[key] = result
+                return result
+            finally:
+                conn.close()
+
+        # Hold the quality tier's first batch in flight for 4 s: the
+        # three posts below coalesce (30 ms window), launch once, and
+        # stall — queue depth sits at the admit watermark on cue.
+        monkeypatch.setenv("WATERNET_FAULT_SLOW_SEC", "4.0")
+        faults.install(faults.FaultPlan.parse("slow_replica@1"))
+        held_results = {}
+        posters = [
+            threading.Thread(target=post, args=({}, held_results, i))
+            for i in range(3)
+        ]
+        for t in posters:
+            t.start()
+        _wait_for(
+            lambda: json.loads(_stats(port))["queue_depth"] >= 3,
+            timeout=30,
+            what="queue depth at the watermark",
+        )
+
+        # Opt-in under saturation: served by the fast tier, not shed.
+        status, headers, body = post({"X-Tier-Allow-Downgrade": "1"})
+        assert status == 200
+        assert headers.get("X-Tier-Served") == "fast"
+        got = cv2.cvtColor(
+            cv2.imdecode(np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR),
+            cv2.COLOR_BGR2RGB,
+        )
+        h, w = bgr.shape[:2]
+        offline_fast = ten2arr(
+            fast.enhance_padded_async([bgr[:, :, ::-1]], BUCKET, n_slots=8)
+        )[0, :h, :w]
+        np.testing.assert_array_equal(got, offline_fast)
+
+        # No opt-in under the same saturation: shed with 429.
+        status, headers, _ = post()
+        assert status == 429
+        assert headers.get("Retry-After") == "1"
+
+        for t in posters:
+            t.join(60)
+        assert all(
+            held_results[i][0] == 200 for i in range(3)
+        ), "held quality requests must still complete"
+        summary = srv.stats.summary()
+        assert summary["downgraded"] == 1
+        assert summary["shed_count"] == 1
+        assert summary["tiers"]["fast"]["requests"] == 1
+    finally:
+        faults.clear()
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+def _stats(port):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", "/stats")
+        return conn.getresponse().read()
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: /healthz degraded + unhealthy states
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_degraded_when_one_replica_quarantined(params, rng):
+    """Some-but-not-all replicas quarantined -> 200 with
+    {"status": "degraded", "replicas": {...}} — a load balancer keeps
+    routing, an operator sees the sick replica by name. A long re-warm
+    backoff keeps the state observable."""
+    import cv2
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving.server import ServingServer
+
+    srv = ServingServer(
+        InferenceEngine(params=params), BucketLadder([BUCKET]), max_batch=4,
+        max_wait_ms=5, replicas=2, max_queue=64,
+        # Watchdog above real batch latency (see the hang test) so only
+        # the injected hang quarantines; the huge backoff keeps the
+        # quarantined state observable.
+        supervision=_sup(watchdog_sec=2.0, rewarm_backoff_sec=60.0),
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        port = srv.bound_port
+        bgr = np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8)
+        ok, buf = cv2.imencode(".png", bgr)
+        assert ok
+        faults.install(faults.FaultPlan.parse("replica_hang@1"))
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request("POST", "/enhance", body=buf.tobytes())
+            resp = conn.getresponse()
+            body = resp.read()
+            # The hung batch re-dispatched to the surviving replica.
+            assert resp.status == 200
+            assert body
+        finally:
+            conn.close()
+
+        def healthz():
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            try:
+                c.request("GET", "/healthz")
+                r = c.getresponse()
+                return r.status, json.loads(r.read())
+            finally:
+                c.close()
+
+        _wait_for(
+            lambda: healthz()[1].get("status") == "degraded",
+            what="degraded healthz",
+        )
+        status, payload = healthz()
+        assert status == 200  # degraded still serves
+        assert payload["status"] == "degraded"
+        states = set(payload["replicas"]["quality"].values())
+        assert "quarantined" in states or "rewarming" in states
+        assert "healthy" in states
+    finally:
+        faults.clear()
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+def test_healthz_unhealthy_when_all_replicas_quarantined(params, rng):
+    """Every replica quarantined -> 503 {"status": "unhealthy"}, and an
+    in-flight request with no surviving replica resolves with a 503 (not
+    a hang, not a 500-as-client-error)."""
+    import cv2
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving.server import ServingServer
+
+    srv = ServingServer(
+        InferenceEngine(params=params), BucketLadder([BUCKET]), max_batch=4,
+        max_wait_ms=5, replicas=1, max_queue=64,
+        supervision=_sup(watchdog_sec=2.0, rewarm_backoff_sec=60.0),
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        port = srv.bound_port
+        bgr = np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8)
+        ok, buf = cv2.imencode(".png", bgr)
+        assert ok
+        faults.install(faults.FaultPlan.parse("replica_hang@1"))
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request("POST", "/enhance", body=buf.tobytes())
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 503  # the ONLY replica is gone
+            assert b"quarantined" in body or b"hung" in body
+        finally:
+            conn.close()
+
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            c.request("GET", "/healthz")
+            r = c.getresponse()
+            payload = json.loads(r.read())
+            assert r.status == 503
+            assert payload["status"] == "unhealthy"
+            assert payload["ready"] is False
+            assert set(payload["replicas"]["quality"].values()) <= {
+                "quarantined", "rewarming"
+            }
+        finally:
+            c.close()
+    finally:
+        faults.clear()
+        srv.request_drain()
+        srv.join()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: close() reports wedged threads loudly
+# ---------------------------------------------------------------------------
+
+
+def test_close_reports_wedged_threads_loudly(params, rng, capfd):
+    """A worker wedged in device work cannot be joined — close() must
+    say so by name on stderr and return the leaked threads, not
+    silently time out (the old behavior). The released hang then lets
+    the threads retire so the suite's leak guard proves they're gone."""
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.serving.batcher import _Request
+    from waternet_tpu.serving.replicas import ReplicaPool
+
+    engine = InferenceEngine(params=params)
+    pool = ReplicaPool(
+        engine, BucketLadder([BUCKET]), [2], n_replicas=1,
+        supervision=_sup(watchdog_sec=None),  # no watchdog: close sees the wedge
+    )
+    img = np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8)
+    req = _Request(img)
+    faults.install(faults.FaultPlan.parse("replica_hang@1"))
+    pool.dispatch(BUCKET, [req])
+    time.sleep(0.3)  # let the launch thread reach the hang
+    leaked = pool.close(timeout=0.5)
+    assert leaked, "close() should have found the wedged threads"
+    assert pool.leaked_threads == leaked
+    assert any("serve-launch" in name for name in leaked)
+    err = capfd.readouterr().err
+    assert "failed to join" in err
+    for name in leaked:
+        assert name in err  # named loudly, not a silent leak
+    # Release the wedge: the retired launcher wakes, serves the batch it
+    # still owns (nothing claimed it), and both workers exit — the
+    # conftest thread-leak guard verifies they are actually gone.
+    faults.clear()
+    assert req.future.result(timeout=30).shape == img.shape
+
+
+# ---------------------------------------------------------------------------
+# Satellite: loadgen accounting — graceful close vs hard transport error
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_distinguishes_reset_from_hard_error():
+    """A peer that closes mid-exchange (what a graceful drain looks like
+    to a pooled client) lands in ``conn_reset``; a connection that never
+    establishes (dead server) lands in ``errors`` — a drain is not a
+    crash, and the report can finally tell them apart."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+
+    def acceptor():
+        for _ in range(4):
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                c.recv(65536)
+            finally:
+                c.close()  # mid-exchange close: the graceful-drain signature
+
+    t = threading.Thread(target=acceptor, daemon=True)
+    t.start()
+    try:
+        rep = run_load(
+            f"http://127.0.0.1:{port}", [b"payload"], concurrency=1, total=2,
+            timeout=10,
+        )
+    finally:
+        srv.close()
+        t.join(10)
+    assert rep["conn_reset"] == 2
+    assert rep["errors"] == 0
+    assert (
+        rep["ok"] + rep["shed"] + rep["deadline_expired"] + rep["rejected"]
+        + rep["conn_reset"] + rep["errors"]
+    ) == rep["sent"]
+
+    # Hard transport error: nothing listens on this port at all.
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()
+    rep = run_load(
+        f"http://127.0.0.1:{dead_port}", [b"payload"], concurrency=1,
+        total=2, timeout=5,
+    )
+    assert rep["errors"] == 2
+    assert rep["conn_reset"] == 0
+
+
+def test_loadgen_sends_downgrade_headers_and_counts_downgrades():
+    """The chaos bench's opt-in traffic: loadgen forwards X-Tier and
+    X-Tier-Allow-Downgrade, and counts 200s whose X-Tier-Served differs
+    from the requested tier as ``downgraded``."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    port = srv.getsockname()[1]
+    seen = {}
+
+    def handler():
+        c, _ = srv.accept()
+        try:
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = c.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            head = data.split(b"\r\n\r\n", 1)[0].decode("latin-1")
+            for line in head.split("\r\n")[1:]:
+                name, _, value = line.partition(":")
+                seen[name.strip().lower()] = value.strip()
+            c.sendall(
+                b"HTTP/1.1 200 OK\r\nContent-Type: image/png\r\n"
+                b"Content-Length: 1\r\nX-Tier-Served: fast\r\n"
+                b"Connection: close\r\n\r\nx"
+            )
+        finally:
+            c.close()
+
+    t = threading.Thread(target=handler, daemon=True)
+    t.start()
+    try:
+        rep = run_load(
+            f"http://127.0.0.1:{port}", [b"img"], concurrency=1, total=1,
+            timeout=10, tier="quality", allow_downgrade=True,
+        )
+    finally:
+        srv.close()
+        t.join(10)
+    assert seen.get("x-tier") == "quality"
+    assert seen.get("x-tier-allow-downgrade") == "1"
+    assert rep["ok"] == 1
+    assert rep["downgraded"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Bench contract: serve_chaos
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serve_chaos_contract_line():
+    """The chaos_images_per_sec line: schema, sustained throughput
+    through an injected crash + hang, quarantine/reintegration with
+    recovery time, and the client-vs-server accounting cross-check."""
+    import bench
+
+    line = bench.bench_serving_chaos(
+        n_images=6, max_batch=2, max_buckets=1, base_hw=24,
+        concurrency=4, requests=20,
+    )
+    assert line["metric"] == "chaos_images_per_sec"
+    assert line["unit"] == "images/sec"
+    assert line["value"] > 0
+    assert line["replicas"] >= 2
+    assert line["quarantines"] >= 1
+    assert line["reintegrations"] >= 1
+    assert line["recovered"] is True
+    assert line["recovery_sec"] > 0
+    assert line["retried"] >= 1
+    assert line["errors"] == 0 and line["conn_reset"] == 0
+    assert line["accounted"] is True, line
+    assert line["downgraded"] >= 0
+    assert line["faults"] == "replica_crash@2,replica_hang@5"
+    assert {"quality", "fast"} <= set(line["replica_health"])
+    json.dumps(line)  # contract line must be JSON-serializable

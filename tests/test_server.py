@@ -129,7 +129,13 @@ def test_healthz_enhance_stats_smoke(server, engine, rng):
     status, _, body = _request(port, "GET", "/healthz")
     assert status == 200
     health = json.loads(body)
-    assert health == {"ready": True, "warmed": True, "draining": False}
+    assert health == {
+        "ready": True,
+        "warmed": True,
+        "draining": False,
+        "status": "ok",
+        "replicas": {"quality": {"0": "healthy"}},
+    }
 
     bgr = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
     status, headers, body = _request(port, "POST", "/enhance", body=_png(bgr))

@@ -352,6 +352,11 @@ def test_batcher_rejects_bad_input_and_use_after_close(engine):
     try:
         with pytest.raises(ValueError, match=r"\(H, W, 3\)"):
             b.submit(np.zeros((4, 4), np.uint8))
+        # dtype is validated at SUBMIT, not at launch: a float image that
+        # raised inside a replica's launch thread would read as a device
+        # fault to the supervisor (docs/SERVING.md "Fault isolation").
+        with pytest.raises(ValueError, match="uint8"):
+            b.submit(np.zeros((4, 4, 3), np.float32))
     finally:
         b.close()
     with pytest.raises(RuntimeError, match="closed"):
@@ -373,7 +378,14 @@ def test_stats_schema_and_latency_percentiles():
     s.record_shed()
     s.record_shed()
     s.record_deadline_expired()
+    s.record_retry(2)
+    s.record_downgrade()
+    s.record_nan_output()
+    s.record_quarantine()
+    s.record_reintegration(0.25)
     s.queue_depth_probe = lambda: 7  # what a live DynamicBatcher registers
+    # what a live DynamicBatcher registers for its replica pools
+    s.replica_health_probe = lambda: {"quality": {0: "healthy"}}
     lat = s.latency_ms()
     assert lat["p50"] == pytest.approx(2.0)
     assert lat["p99"] == pytest.approx(100.0)
@@ -383,10 +395,21 @@ def test_stats_schema_and_latency_percentiles():
     assert set(summary) == {
         "requests", "batches", "latency_ms", "batch_occupancy",
         "padding_overhead", "compiles", "fallback_native_shapes",
-        "shed_count", "deadline_expired", "queue_depth",
+        "shed_count", "deadline_expired", "retried", "downgraded",
+        "nan_outputs", "quarantines", "reintegrations",
+        "recovery_sec_max", "replica_health", "queue_depth",
         "queue_depth_mean", "queue_depth_max", "replicas",
         "images_per_sec", "load_imbalance", "tiers", "per_replica",
     }
+    # Fault-isolation counters (docs/SERVING.md "Fault isolation").
+    assert summary["retried"] == 2
+    assert summary["downgraded"] == 1
+    assert summary["nan_outputs"] == 1
+    assert summary["quarantines"] == 1
+    assert summary["reintegrations"] == 1
+    assert summary["recovery_sec_max"] == pytest.approx(0.25)
+    assert summary["replica_health"] == {"quality": {0: "healthy"}}
+    assert ServingStats().summary()["replica_health"] == {}
     # Per-tier counters (docs/SERVING.md "Quality tiers"): the quality
     # tier always reports; a declared-but-idle fast tier shows zeros.
     assert summary["tiers"]["quality"] == {"requests": 3, "batches": 1}
@@ -915,6 +938,7 @@ def test_bench_serving_multi_scales_on_multicore():
     [("serve", "mixed_res_dir_images_per_sec"),
      ("serve_multi", "mixed_res_dir_images_per_sec_multidev"),
      ("serve_http", "http_images_per_sec"),
+     ("serve_chaos", "chaos_images_per_sec"),
      ("tiers", "fast_tier_images_per_sec")],
 )
 def test_bench_serve_fail_line_keeps_own_metric(config, metric):

@@ -25,7 +25,22 @@ is a set of one-shot events, each keyed by a deterministic counter:
   sleeps ``WATERNET_FAULT_SLOW_SEC`` (default 0.25) before dispatching,
   simulating a replica whose device stalls mid-serve — the deterministic
   way to hold work in flight so drain, deadline-expiry, and shed paths
-  are testable (serving/replicas.py calls :func:`replica_launch_delay`).
+  are testable (serving/replicas.py calls :func:`replica_launch_fault`).
+* ``replica_crash@K`` — the K-th bucketed batch launch raises, the
+  faithful signature of a replica whose XLA dispatch dies mid-serve.
+  The supervised pool (docs/SERVING.md "Fault isolation") must contain
+  it: the batch's requests re-dispatch onto surviving replicas and the
+  sick replica walks the quarantine → re-warm → reintegrate machine.
+* ``replica_hang@K`` — the K-th bucketed batch launch blocks
+  indefinitely (a wedged driver / stalled device), releasable: the
+  wedged thread wakes when the plan is cleared or replaced
+  (:func:`clear` / :func:`install`), so tests can assert the watchdog
+  path and still join every thread. Until release, the launch neither
+  completes nor raises — exactly what a watchdog exists to catch.
+* ``nan_output@K`` — the K-th *completed* serving batch's host array is
+  poisoned after D2H (float outputs → NaN, uint8 outputs → an all-zero
+  canvas), exercising the replica pool's output sanity guard
+  (serving/replicas.py calls :func:`poison_replica_output`).
 * ``reject_admit@K`` — the K-th admission attempt at the HTTP front door
   (1-based, process-global) is force-shed with 429 regardless of queue
   depth, exercising the shed path and client retry behavior without
@@ -50,13 +65,20 @@ import os
 import signal
 import threading
 from pathlib import Path
+from typing import NamedTuple
 
 _PLAN: "FaultPlan | None" = None
 _IMREAD_CALLS = 0
 _IMREAD_LOCK = threading.Lock()
 _LAUNCH_CALLS = 0
 _ADMIT_CALLS = 0
+_COMPLETE_CALLS = 0
 _SERVE_LOCK = threading.Lock()
+#: Release latch for armed ``replica_hang`` events: a wedged launch thread
+#: waits on this, and :func:`install` / :func:`clear` set it — so a test
+#: (or an operator fire drill) can un-wedge the "hung device" on cue and
+#: every thread stays joinable.
+_HANG_RELEASE = threading.Event()
 
 
 class FaultPlan:
@@ -64,7 +86,8 @@ class FaultPlan:
 
     KINDS = (
         "nan", "sigterm", "truncate_ckpt", "decode",
-        "slow_replica", "reject_admit",
+        "slow_replica", "replica_crash", "replica_hang", "nan_output",
+        "reject_admit",
     )
 
     def __init__(self, events=()):
@@ -104,12 +127,23 @@ class FaultPlan:
 
 def install(plan: FaultPlan | None) -> None:
     global _PLAN, _IMREAD_CALLS, _LAUNCH_CALLS, _ADMIT_CALLS
-    _PLAN = plan
-    with _IMREAD_LOCK:
-        _IMREAD_CALLS = 0
+    global _COMPLETE_CALLS, _HANG_RELEASE
     with _SERVE_LOCK:
+        # Release any launch thread wedged by the PREVIOUS plan's
+        # replica_hang before swapping latches: hangs are releasable by
+        # contract (the thread-leak guard depends on it). The swap
+        # happens under the same lock that fires hang events, so a
+        # thread that drew hang=True always holds the latch its plan
+        # armed — it can never miss its release by racing the swap.
+        _HANG_RELEASE.set()
+        _PLAN = plan
+        if plan is not None:
+            _HANG_RELEASE = threading.Event()  # fresh latch for this plan
         _LAUNCH_CALLS = 0
         _ADMIT_CALLS = 0
+        _COMPLETE_CALLS = 0
+    with _IMREAD_LOCK:
+        _IMREAD_CALLS = 0
 
 
 def clear() -> None:
@@ -176,23 +210,86 @@ def imread_should_fail() -> bool:
         return _PLAN.fire("decode", _IMREAD_CALLS)
 
 
-def replica_launch_delay() -> float:
+class LaunchFault(NamedTuple):
+    """What the K-th bucketed batch launch should do (one counter, three
+    serving-side kinds — the ordinal in ``slow_replica@K`` /
+    ``replica_crash@K`` / ``replica_hang@K`` is the same launch count).
+    ``hang`` is None, or the release :class:`threading.Event` the armed
+    plan owns — captured atomically with the fire, so the wedged thread
+    always waits on the latch that :func:`clear`/:func:`install` will
+    set for it."""
+
+    delay: float
+    crash: bool
+    hang: "threading.Event | None"
+
+
+_NO_LAUNCH_FAULT = LaunchFault(0.0, False, None)
+
+
+def replica_launch_fault() -> LaunchFault:
     """Hook run before each bucketed batch launch in
     :meth:`waternet_tpu.serving.replicas._Replica._launch_loop`.
 
-    Returns the seconds this launch should stall (kind ``slow_replica``,
-    keyed by a process-global launch counter across every replica's
-    launch thread; delay from ``WATERNET_FAULT_SLOW_SEC``, default 0.25)
-    or 0.0. With no plan installed this is a single ``is None`` check.
+    Keyed by a process-global launch counter across every replica's (and
+    every tier pool's) launch thread, under a lock. ``delay`` is the
+    seconds this launch should stall (kind ``slow_replica``, from
+    ``WATERNET_FAULT_SLOW_SEC``, default 0.25); ``crash`` means the
+    launch must raise (kind ``replica_crash``); a non-None ``hang`` is
+    the release latch the launch must block on (kind ``replica_hang`` —
+    the latch is set by :func:`clear`/:func:`install`, making every
+    injected wedge releasable). With no plan installed this is a single
+    ``is None`` check.
     """
     global _LAUNCH_CALLS
     if _PLAN is None:
-        return 0.0
+        return _NO_LAUNCH_FAULT
     with _SERVE_LOCK:
         _LAUNCH_CALLS += 1
-        if _PLAN.fire("slow_replica", _LAUNCH_CALLS):
-            return float(os.environ.get("WATERNET_FAULT_SLOW_SEC", "0.25"))
-    return 0.0
+        k = _LAUNCH_CALLS
+        delay = (
+            float(os.environ.get("WATERNET_FAULT_SLOW_SEC", "0.25"))
+            if _PLAN.fire("slow_replica", k)
+            else 0.0
+        )
+        crash = _PLAN.fire("replica_crash", k)
+        hang = _HANG_RELEASE if _PLAN.fire("replica_hang", k) else None
+    return LaunchFault(delay, crash, hang)
+
+
+def replica_launch_delay() -> float:
+    """Back-compat form of :func:`replica_launch_fault` for callers that
+    only stall (same counter: one call = one launch ordinal)."""
+    return replica_launch_fault().delay
+
+
+def poison_replica_output(arr):
+    """Hook run on each completed serving batch's host array, after the
+    D2H sync in :meth:`waternet_tpu.serving.replicas._Replica._complete_loop`.
+
+    Kind ``nan_output``, keyed by a process-global completed-batch
+    counter. When armed for this ordinal, returns a poisoned copy —
+    float arrays go non-finite, integer arrays go all-zero: the two
+    signatures the pool's output sanity guard detects. Otherwise returns
+    ``arr`` unchanged; with no plan installed this is a single ``is
+    None`` check.
+    """
+    global _COMPLETE_CALLS
+    if _PLAN is None:
+        return arr
+    with _SERVE_LOCK:
+        _COMPLETE_CALLS += 1
+        fired = _PLAN.fire("nan_output", _COMPLETE_CALLS)
+    if not fired:
+        return arr
+    import numpy as np
+
+    out = np.array(arr)
+    if np.issubdtype(out.dtype, np.floating):
+        out[...] = np.nan
+    else:
+        out[...] = 0
+    return out
 
 
 def admit_should_reject() -> bool:

@@ -17,6 +17,8 @@ from waternet_tpu.serving.batcher import (
 )
 from waternet_tpu.serving.replicas import (
     ReplicaPool,
+    ReplicaUnavailable,
+    SupervisionConfig,
     engine_jit_cache_size,
     resolve_replicas,
 )
@@ -40,7 +42,9 @@ __all__ = [
     "ExactShapeBatcher",
     "QueueFull",
     "ReplicaPool",
+    "ReplicaUnavailable",
     "ServingStats",
+    "SupervisionConfig",
     "UnknownTier",
     "derive_buckets",
     "engine_jit_cache_size",

@@ -46,6 +46,7 @@ from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.serving.bucketing import BucketLadder
 from waternet_tpu.serving.replicas import (
     ReplicaPool,
+    SupervisionConfig,
     engine_jit_cache_size,
     resolve_replicas,
 )
@@ -79,16 +80,23 @@ class DeadlineExpired(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_submit", "t_admit", "deadline", "tier")
+    __slots__ = ("image", "future", "t_submit", "t_admit", "deadline",
+                 "tier", "retries", "allow_downgrade")
 
     def __init__(
         self,
         image: np.ndarray,
         deadline: Optional[float] = None,
         tier: str = "quality",
+        allow_downgrade: bool = False,
     ):
         self.image = image
         self.tier = tier
+        # Re-dispatch budget consumed by the replica pool when this
+        # request's batch demonstrably fails (docs/SERVING.md "Fault
+        # isolation"); ``allow_downgrade`` is the brown-out opt-in.
+        self.retries = 0
+        self.allow_downgrade = allow_downgrade
         self.future: Future = Future()
         # t_submit anchors the reported request latency; t_admit (set when
         # the dispatcher moves the request into its bucket's pending list)
@@ -153,11 +161,18 @@ class DynamicBatcher:
         max_queue: int = 8192,
         fast_engine=None,
         tier_name: str = "quality",
+        supervision: Optional[SupervisionConfig] = None,
+        downgrade_watermark: Optional[int] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if downgrade_watermark is not None and downgrade_watermark < 1:
+            raise ValueError(
+                f"downgrade_watermark must be >= 1 (or None to disable "
+                f"brown-out downgrades), got {downgrade_watermark}"
+            )
         # ``tier_name`` labels the PRIMARY engine's pool in the stats —
         # "fast" when the CLI serves a StudentEngine alone (--tier fast),
         # so the stats block names the tier that actually served. A
@@ -187,12 +202,16 @@ class DynamicBatcher:
         self.stats = stats if stats is not None else ServingStats()
         # No request ever pays a compile: the whole per-replica executable
         # grid is built before the first submit is accepted.
+        self.supervision = (
+            supervision if supervision is not None else SupervisionConfig()
+        )
+        self.downgrade_watermark = downgrade_watermark
         self._pool = ReplicaPool(
             engine, ladder, [self.max_batch],
             n_replicas=resolve_replicas(replicas, engine),
             max_inflight_per_replica=max_inflight_per_replica,
             stats=self.stats, warmup_verbose=warmup_verbose,
-            tier=tier_name,
+            tier=tier_name, supervision=self.supervision,
         )
         # Per-request tier routing (docs/SERVING.md "Quality tiers"):
         # ``fast_engine`` (a StudentEngine) gets its OWN replica pool on
@@ -215,11 +234,17 @@ class DynamicBatcher:
                 n_replicas=self._pool.n_replicas,
                 max_inflight_per_replica=max_inflight_per_replica,
                 stats=self.stats, warmup_verbose=warmup_verbose,
-                tier="fast",
+                tier="fast", supervision=self.supervision,
             )
         self._requests: queue.Queue = queue.Queue()
         self._closed = False
         self.max_queue = int(max_queue)
+        # Per-tier outstanding counts (submit lock): the quality tier's
+        # backlog is the brown-out pressure gauge — past
+        # ``downgrade_watermark``, opted-in quality requests route to the
+        # fast tier instead of queueing (docs/SERVING.md "Fault
+        # isolation").
+        self._tier_backlog = {t: 0 for t in self._pools}
         # Outstanding-request count: submitted and not yet RESOLVED —
         # queued, coalescing, or in flight on a replica. This is the
         # admission-control gauge and the QueueFull bound: the
@@ -232,6 +257,7 @@ class DynamicBatcher:
         # covers every resolution path (result, error, deadline drop).
         self._backlog = 0
         self.stats.queue_depth_probe = self.queue_depth
+        self.stats.replica_health_probe = self.health
         # Makes the closed-check + enqueue atomic vs close(): without it a
         # racing submit() could land its request BEHIND the _CLOSE
         # sentinel, where the dispatcher never looks — the caller would
@@ -261,6 +287,7 @@ class DynamicBatcher:
         image: np.ndarray,
         deadline: Optional[float] = None,
         tier: Optional[str] = None,
+        allow_downgrade: bool = False,
     ) -> Future:
         """Queue one (H, W, 3) uint8 image; resolves to its enhanced
         native-shape uint8 array. Thread-safe.
@@ -279,6 +306,14 @@ class DynamicBatcher:
         batcher): "quality" is the full WaterNet pipeline, "fast" the
         CAN student pool. Any other name — or a tier this batcher does
         not serve — raises :class:`UnknownTier`.
+
+        ``allow_downgrade`` is the brown-out opt-in (docs/SERVING.md
+        "Fault isolation"): when the quality tier's outstanding count
+        sits at/past ``downgrade_watermark`` and a fast pool is
+        configured, an opted-in quality request is served by the fast
+        tier instead of queueing (counted in ``stats.downgraded``).
+        Requests that did not opt in are NEVER downgraded. The returned
+        future carries the tier that actually serves it as ``.tier``.
         """
         tier = self._default_tier if tier is None else str(tier).lower()
         if tier not in ("quality", "fast"):
@@ -301,14 +336,32 @@ class DynamicBatcher:
             raise ValueError(
                 f"expected one (H, W, 3) image, got shape {image.shape}"
             )
+        if image.dtype != np.uint8:
+            # Validated HERE, loudly: a non-uint8 image would raise at
+            # LAUNCH instead, where the supervised pool cannot tell a
+            # poison-pill request from a sick device — one bad submit
+            # could strike (and cascade-quarantine) healthy replicas.
+            raise ValueError(
+                f"expected a uint8 image, got dtype {image.dtype} (the "
+                "serving contract is (H, W, 3) uint8)"
+            )
         if deadline is not None and deadline <= time.perf_counter():
             self.stats.record_deadline_expired()
             raise DeadlineExpired(
                 "deadline already past at admission (the coalescing window "
                 "plus compute cannot finish in negative time)"
             )
-        req = _Request(image, deadline=deadline, tier=tier)
+        req = _Request(
+            image, deadline=deadline, tier=tier,
+            allow_downgrade=allow_downgrade,
+        )
+        # The callback reads the served tier off the FUTURE (set below,
+        # before enqueue — resolution cannot precede dispatch), not off a
+        # captured request: Future keeps its callbacks after resolution,
+        # so a req-capturing closure would pin every input image for as
+        # long as the caller holds the future.
         req.future.add_done_callback(self._on_request_resolved)
+        downgraded = False
         with self._submit_lock:
             if self._closed:
                 raise RuntimeError("DynamicBatcher is closed")
@@ -318,16 +371,41 @@ class DynamicBatcher:
                     f"{self._backlog} requests outstanding, max_queue="
                     f"{self.max_queue}: shedding instead of queueing forever"
                 )
+            if (
+                allow_downgrade
+                and req.tier == "quality"
+                and "fast" in self._pools
+                and self.downgrade_watermark is not None
+                and self._tier_backlog.get("quality", 0)
+                >= self.downgrade_watermark
+            ):
+                # Brown-out: the quality queue is saturated and the
+                # request opted in — a fast-tier answer now beats a 429.
+                req.tier = "fast"
+                downgraded = True
+            req.future.tier = req.tier  # the tier that will actually serve
             self._backlog += 1
+            self._tier_backlog[req.tier] = (
+                self._tier_backlog.get(req.tier, 0) + 1
+            )
             self._requests.put(req)
+        if downgraded:
+            self.stats.record_downgrade()
         return req.future
 
-    def _on_request_resolved(self, _future) -> None:
+    def _on_request_resolved(self, future) -> None:
         """Done-callback on every request future: runs on whichever
         thread resolves it (replica completion, error path, deadline
-        drop), so the outstanding count can never leak."""
+        drop), so the outstanding counts — global and per-tier — can
+        never leak. The tier rides the future itself (``future.tier``,
+        stamped at submit before enqueue)."""
+        tier = getattr(future, "tier", None)
         with self._submit_lock:
             self._backlog -= 1
+            if tier is not None:
+                self._tier_backlog[tier] = (
+                    self._tier_backlog.get(tier, 0) - 1
+                )
 
     def queue_depth(self) -> int:
         """Live outstanding-request count (queued + coalescing + in
@@ -336,6 +414,18 @@ class DynamicBatcher:
         ``stats.summary()``."""
         with self._submit_lock:
             return self._backlog
+
+    def tier_depth(self, tier: str) -> int:
+        """Live outstanding-request count for one tier — the quality
+        tier's is the brown-out pressure gauge."""
+        with self._submit_lock:
+            return self._tier_backlog.get(tier, 0)
+
+    def health(self) -> dict:
+        """Live per-tier replica health map, ``{tier: {index: state}}``
+        (docs/SERVING.md "Fault isolation") — what ``/healthz`` degrades
+        on and ``stats.summary()['replica_health']`` reports."""
+        return {t: pool.health() for t, pool in self._pools.items()}
 
     def set_params(self, params) -> None:
         """Hot weight reload of the QUALITY tier: atomically swap every

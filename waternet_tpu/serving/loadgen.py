@@ -11,10 +11,18 @@ runs exactly that A/B).
 
 Accounting is total: every request ends in exactly one of ``ok`` /
 ``shed`` (429) / ``deadline_expired`` (504) / ``rejected`` (other 4xx/
-5xx, e.g. 503 while draining) / ``errors`` (transport), so the overload
-acceptance criterion — no silent drops — is checkable from the report
-alone. Stdlib-only (http.client + threads); worker threads carry the
-pipeline ``THREAD_PREFIX`` so the test suite's leak guard covers them.
+5xx, e.g. 503 while draining) / ``conn_reset`` (the peer closed the
+connection mid-exchange — the signature of a graceful drain racing a
+pooled client, NOT a crash) / ``errors`` (hard transport failures:
+refused, timed out, unroutable), so the overload acceptance criterion —
+no silent drops — is checkable from the report alone, and a drain test
+can tell a graceful close from a dead server. ``downgraded`` counts the
+subset of ``ok`` responses served by a different tier than requested
+(the server's ``X-Tier-Served`` header under brown-out,
+docs/SERVING.md "Fault isolation") — drive opt-in traffic with
+``allow_downgrade=True`` / ``--allow-downgrade``. Stdlib-only
+(http.client + threads); worker threads carry the pipeline
+``THREAD_PREFIX`` so the test suite's leak guard covers them.
 """
 
 from __future__ import annotations
@@ -41,20 +49,26 @@ def run_load(
     path: str = "/enhance",
     timeout: float = 120.0,
     keep_bodies: bool = False,
+    tier: Optional[str] = None,
+    allow_downgrade: bool = False,
 ) -> Dict:
     """Drive ``total`` POSTs at ``path`` with ``concurrency`` closed-loop
     workers cycling through ``payloads``; returns the accounting report.
 
     ``keep_bodies=True`` additionally returns ``bodies`` — a list of
     ``(request_index, status, body_bytes)`` — so byte-identity tests can
-    check every response against the offline path.
+    check every response against the offline path. ``tier`` is forwarded
+    as ``X-Tier``; ``allow_downgrade=True`` sets
+    ``X-Tier-Allow-Downgrade: 1`` (the brown-out opt-in) and the report's
+    ``downgraded`` counts 200s whose ``X-Tier-Served`` differs from the
+    requested tier.
     """
     u = urlparse(url)
     host, port = u.hostname, u.port or 80
     lock = threading.Lock()
     counts = {
         "ok": 0, "shed": 0, "deadline_expired": 0, "rejected": 0,
-        "errors": 0,
+        "conn_reset": 0, "errors": 0, "downgraded": 0,
     }
     latencies: List[float] = []
     bodies: List = []
@@ -74,18 +88,35 @@ def run_load(
                 headers = {"Content-Type": "application/octet-stream"}
                 if deadline_ms is not None:
                     headers["X-Deadline-Ms"] = str(deadline_ms)
+                if tier is not None:
+                    headers["X-Tier"] = tier
+                if allow_downgrade:
+                    headers["X-Tier-Allow-Downgrade"] = "1"
                 t0 = time.perf_counter()
                 try:
                     conn.request("POST", path, body=payload, headers=headers)
                     resp = conn.getresponse()
                     body = resp.read()
                     status = resp.status
+                    served = resp.getheader("X-Tier-Served", "")
                     closed = (
                         resp.getheader("Connection", "").lower() == "close"
                     )
-                except Exception:
+                except Exception as err:
+                    # A peer closing mid-exchange (ConnectionResetError,
+                    # incl. http.client.RemoteDisconnected, BrokenPipeError)
+                    # is what a graceful drain looks like to a pooled
+                    # client — counted apart from hard transport errors
+                    # (refused, timed out): a drain is not a crash.
+                    key = (
+                        "conn_reset"
+                        if isinstance(
+                            err, (ConnectionResetError, BrokenPipeError)
+                        )
+                        else "errors"
+                    )
                     with lock:
-                        counts["errors"] += 1
+                        counts[key] += 1
                     conn.close()
                     conn = http.client.HTTPConnection(
                         host, port, timeout=timeout
@@ -96,6 +127,11 @@ def run_load(
                     if status == 200:
                         counts["ok"] += 1
                         latencies.append(dt)
+                        # Only meaningful when a tier was REQUESTED: a
+                        # fast-default server answering tier-less traffic
+                        # with X-Tier-Served: fast is not a downgrade.
+                        if tier is not None and served and served != tier:
+                            counts["downgraded"] += 1
                     elif status == 429:
                         counts["shed"] += 1
                     elif status == 504:
@@ -174,6 +210,19 @@ def main(argv=None) -> int:
     parser.add_argument("--concurrency", type=int, default=4)
     parser.add_argument("--requests", type=int, default=64)
     parser.add_argument("--deadline-ms", type=float, default=None)
+    parser.add_argument(
+        "--tier", type=str, default=None,
+        choices=["quality", "fast"],
+        help="Forwarded as X-Tier (default: no header, the server's "
+        "default tier).",
+    )
+    parser.add_argument(
+        "--allow-downgrade", action="store_true", default=False,
+        help="Opt this traffic into brown-out downgrades "
+        "(X-Tier-Allow-Downgrade: 1): under saturation the server may "
+        "serve quality requests from the fast tier instead of shedding "
+        "— the report's 'downgraded' counts how often it did.",
+    )
     args = parser.parse_args(argv)
 
     if args.source:
@@ -195,6 +244,8 @@ def main(argv=None) -> int:
         concurrency=args.concurrency,
         total=args.requests,
         deadline_ms=args.deadline_ms,
+        tier=args.tier,
+        allow_downgrade=args.allow_downgrade,
     )
     print(json.dumps(report))
     return 0

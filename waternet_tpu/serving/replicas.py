@@ -1,5 +1,6 @@
 """Multi-device serving scale-out: a pool of per-device replicas under the
-dynamic batcher (docs/SERVING.md "Replica pool").
+dynamic batcher, with per-replica supervision and fault isolation
+(docs/SERVING.md "Replica pool" and "Fault isolation").
 
 WaterNet's serving forward is ~1 MFLOP/pixel with no cross-request state,
 so aggregate images/sec should scale near-linearly with device count once
@@ -14,46 +15,181 @@ threads, so
 * device compute on replica *j*, and
 * D2H readback on replica *k*
 
-all overlap freely — a blocking ``ten2arr`` on one device never stalls
+all overlap freely — a blocking readback on one device never stalls
 dispatch or compute on another (the PR-2 pipeline discipline, per
 device). The batcher's dispatcher routes each coalesced micro-batch to
-the **least-loaded replica** (fewest outstanding batches, ties to the
-lowest index — deterministic), and a bounded ``max_inflight_per_replica``
-keeps every device double-buffered without letting any of them run away
-with the queue.
+the **least-loaded available replica** (fewest outstanding batches, ties
+to the lowest index — deterministic), and a bounded
+``max_inflight_per_replica`` keeps every device double-buffered without
+letting any of them run away with the queue.
+
+**Fault isolation.** One sick device must not take the pool down with
+it, so every replica runs a health state machine
+
+    healthy -> suspect -> quarantined -> rewarming -> (reintegrated)
+
+driven by a supervisor thread with per-batch **watchdog deadlines**:
+
+* a batch that *raises* (XLA dispatch death, a poisoned transfer) marks
+  its replica ``suspect`` and its requests re-dispatch onto surviving
+  replicas (bounded per-request retries); the supervisor then
+  quarantines the suspect;
+* a batch that *hangs* past ``watchdog_sec`` (wedged driver, stalled
+  device) is detected by the supervisor, its replica quarantined with
+  fresh worker threads (the wedged ones are retired — they cannot be
+  interrupted, only replaced), and its stranded requests re-dispatched;
+* a completed batch whose host array fails the **output sanity guard**
+  (non-finite values / all-zero canvas) is treated exactly like a crash:
+  counted (``nan_outputs``) and retried;
+* a quarantined replica is **re-warmed** — a probe batch through its
+  existing AOT executables (reused, zero compiles) on its fresh threads,
+  watchdog-guarded — and reintegrated on success, with exponential
+  backoff on probe failure.
+
+Retries are **byte-identical** by the replica-invariance argument below
+(same params, same XLA program on every replica), and a batch is retried
+only when it *demonstrably* failed: a claim protocol under the pool lock
+guarantees exactly one delivery per request — a hung batch that
+eventually completes after its requests were re-dispatched is discarded,
+and a batch that completes before the watchdog fires is never recomputed.
 
 Outputs are replica-count-invariant by construction: every replica runs
 the same XLA program on the same params, and a request's output never
 depends on its batchmates (the PR-4 exactness policy), so the same
 request stream produces byte-identical results whether it lands on
-replica 0 or 7 — pinned in tests/test_serving.py.
+replica 0 or 7 — or is transparently re-dispatched from a dying replica
+to a surviving one (pinned in tests/test_serving.py and
+tests/test_fault_isolation.py).
 
 Scope: replicas are for unsharded engines (each replica is one whole
 device). ``data_shards``/``spatial_shards`` engines already span their
 mesh with a single executable and therefore always resolve to ONE
 replica — the mesh *is* the parallelism there. Oversize requests (no
-covering bucket) keep the jit-cache native-shape fallback and are pinned
-to replica 0 so their compile accounting stays race-free.
+covering bucket) keep the jit-cache native-shape fallback, routed to the
+lowest-index available replica, with the compile-count probe serialized
+under a pool-level lock (quarantine can move the routing mid-stream, so
+"one replica runs fallbacks" is no longer a structural guarantee).
 
 All worker threads run under the input pipeline's ``THREAD_PREFIX`` so
-the test suite's thread-leak guard covers pool shutdown too.
+the test suite's thread-leak guard covers pool shutdown too — and
+:meth:`ReplicaPool.close` reports any thread that fails to join (a
+wedged device) **loudly** instead of silently leaking it.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import queue
+import sys
 import threading
 import time
+from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.resilience import faults
 from waternet_tpu.serving.bucketing import Bucket, BucketLadder
 from waternet_tpu.serving.stats import ServingStats
-from waternet_tpu.serving.warmup import warmup
+from waternet_tpu.serving.warmup import probe_image, warmup
 from waternet_tpu.utils.tensor import ten2arr
 
 _CLOSE = object()
+
+#: Replica health states (docs/SERVING.md "Fault isolation").
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+REWARMING = "rewarming"
+
+#: States in which a replica accepts new work. A suspect replica keeps
+#: serving until the supervisor's next scan quarantines it — the window
+#: is one scan interval, and claims keep any double-delivery impossible.
+AVAILABLE_STATES = (HEALTHY, SUSPECT)
+
+
+class ReplicaUnavailable(RuntimeError):
+    """No replica in an available state could take the work: everything
+    is quarantined (or the quarantined replica was the only one and its
+    requests exhausted their retries). The HTTP front door answers 503 —
+    and ``/healthz`` has been reporting the pool unhealthy since the
+    last quarantine."""
+
+
+class BadOutput(RuntimeError):
+    """A completed batch failed the output sanity guard (non-finite
+    values or an all-zero canvas after D2H) more times than the retry
+    budget allows."""
+
+
+@dataclasses.dataclass
+class SupervisionConfig:
+    """Knobs for the replica supervisor (docs/SERVING.md "Fault
+    isolation"). The defaults are production-shaped: a generous watchdog
+    (real batches finish in milliseconds; 30 s only ever fires on a
+    genuinely wedged device) and a small re-warm backoff so a transient
+    fault costs milliseconds of capacity, not minutes."""
+
+    #: Seconds a dispatched batch may stay in flight (dequeue -> host
+    #: delivery) before its replica is declared hung and quarantined.
+    #: None disables the watchdog (crash isolation still works).
+    watchdog_sec: Optional[float] = 30.0
+    #: Watchdog for OVERSIZE FALLBACK launches, separate because their
+    #: launch legitimately blocks on a first-time XLA compile of the
+    #: native shape — routinely far longer than any sane bucketed-batch
+    #: watchdog. None (the default) exempts fallbacks entirely (the
+    #: pre-supervision behavior: a wedged fallback strands its launcher
+    #: and whatever is queued behind it — the price of not
+    #: false-quarantining every slow compile); operators whose oversize
+    #: traffic matters set it ABOVE their worst native-shape compile
+    #: time to get hang coverage there too.
+    fallback_watchdog_sec: Optional[float] = None
+    #: Per-request bound on re-dispatches after demonstrable batch
+    #: failures; past it the request's future gets the causing error.
+    max_retries: int = 2
+    #: Delay before the first re-warm probe of a quarantined replica
+    #: (doubles per failed probe up to ``max_rewarm_backoff_sec``).
+    rewarm_backoff_sec: float = 0.05
+    max_rewarm_backoff_sec: float = 5.0
+    #: Supervisor scan cadence (watchdog resolution).
+    scan_interval_sec: float = 0.02
+    #: Check every completed batch for non-finite / all-zero output.
+    output_guard: bool = True
+
+
+class _Inflight:
+    """One dispatched batch under watchdog supervision. ``state`` moves
+    ``live -> claimed`` (a worker delivered or errored it) or ``live ->
+    aborted`` (the supervisor declared it failed and re-dispatched its
+    requests); the transition happens exactly once, under the pool lock
+    — the single-delivery guarantee."""
+
+    __slots__ = ("replica", "bucket", "reqs", "deadline", "state",
+                 "probe", "t0")
+
+    def __init__(self, replica, bucket, reqs, deadline, probe):
+        self.replica = replica
+        self.bucket = bucket
+        self.reqs = reqs
+        self.deadline = deadline
+        self.state = "live"
+        self.probe = probe
+        self.t0 = None
+
+
+class _ProbeRequest:
+    """The single request of a re-warm probe batch: same attribute shape
+    as the batcher's requests, never counted in serving stats."""
+
+    __slots__ = ("image", "future", "t_submit", "retries", "tier")
+
+    def __init__(self, image):
+        self.image = image
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+        self.retries = 0
+        self.tier = None
 
 
 def engine_jit_cache_size(engine) -> int:
@@ -119,9 +255,12 @@ def resolve_replicas(spec, engine=None) -> int:
 
 class _Replica:
     """One serving device: its params copy, its executable grid, a work
-    queue feeding a launch thread (host preprocess + async dispatch), and
-    a bounded in-flight queue feeding a completion thread (the replica's
-    one D2H sync point)."""
+    queue feeding a launch thread (host preprocess + async dispatch), a
+    bounded in-flight queue feeding a completion thread (the replica's
+    one D2H sync point) — and a health state the supervisor drives.
+    Worker threads are per-*generation*: a quarantine retires the current
+    pair (wedged threads cannot be interrupted, only replaced) and spawns
+    a fresh pair on fresh queues."""
 
     def __init__(self, pool: "ReplicaPool", index: int, device):
         self.pool = pool
@@ -129,20 +268,39 @@ class _Replica:
         self.device = device
         self.params = pool.engine.replica_params(device)
         self.executables: Dict[Tuple[Bucket, int], object] = {}
-        self.outstanding = 0  # batches dispatched, not yet completed (pool lock)
+        self.outstanding = 0  # batches dispatched, not yet resolved (pool lock)
+        self.state = HEALTHY
+        self.gen = 0
+        self.crashes = 0
+        self.hangs = 0
+        self.bad_outputs = 0
+        self.quarantines = 0
+        self.reintegrations = 0
+        self._quarantined_at: Optional[float] = None
+        self._rewarm_backoff = 0.0
+        self._next_rewarm_at = 0.0
+        self._probe: Optional[Future] = None
+        self._spawn()
+
+    def _spawn(self) -> None:
+        """Fresh queues + worker threads for the current generation (not
+        started — callers start them; respawn() starts immediately)."""
         self.work: queue.Queue = queue.Queue()
         # Launch at most max_inflight batches ahead of this replica's
         # completion sync: the device stays double-buffered, and a slow
         # D2H cannot pile unbounded device allocations behind it.
-        self.inflight: queue.Queue = queue.Queue(maxsize=pool.max_inflight)
+        self.inflight: queue.Queue = queue.Queue(maxsize=self.pool.max_inflight)
+        suffix = f"-{self.index}" if self.gen == 0 else f"-{self.index}g{self.gen}"
         self._launcher = threading.Thread(
             target=self._launch_loop,
-            name=f"{THREAD_PREFIX}-serve-launch-{index}",
+            args=(self.work, self.inflight, self.gen),
+            name=f"{THREAD_PREFIX}-serve-launch{suffix}",
             daemon=True,
         )
         self._completer = threading.Thread(
             target=self._complete_loop,
-            name=f"{THREAD_PREFIX}-serve-complete-{index}",
+            args=(self.inflight,),
+            name=f"{THREAD_PREFIX}-serve-complete{suffix}",
             daemon=True,
         )
 
@@ -150,26 +308,64 @@ class _Replica:
         self._launcher.start()
         self._completer.start()
 
+    def respawn(self):
+        """Retire the current worker generation (caller holds the pool
+        lock and has already bumped ``gen``): returns the old (work
+        queue, threads) and installs started fresh ones."""
+        old_work, old_threads = self.work, [self._launcher, self._completer]
+        self._spawn()
+        self.start()
+        return old_work, old_threads
+
     # -- launch side ---------------------------------------------------
 
-    def _launch_loop(self) -> None:
+    def _launch_loop(self, work_q, inflight_q, gen) -> None:
         pool = self.pool
         while True:
-            item = self.work.get()
+            item = work_q.get()
             if item is _CLOSE:
-                self.inflight.put(_CLOSE)
+                inflight_q.put(_CLOSE)
                 return
-            bucket, reqs, depth = item
+            bucket, reqs, depth, probe = item
+            if bucket is None:
+                self._launch_fallback(reqs, inflight_q, work_q)
+                continue
+            entry = pool._register(self, bucket, reqs, probe)
             try:
-                if bucket is None:
-                    self._launch_fallback(reqs)
-                    continue
-                # Deterministic serving-side fault hook (docs/RESILIENCE.md):
-                # an armed slow_replica@K stalls the K-th launch so drain /
-                # deadline / shed paths can hold work in flight on cue.
-                delay = faults.replica_launch_delay()
-                if delay > 0.0:
-                    time.sleep(delay)
+                if not probe:
+                    # Deterministic serving-side fault hooks
+                    # (docs/RESILIENCE.md): slow_replica stalls this
+                    # launch, replica_crash raises, replica_hang blocks
+                    # until the plan is cleared (the releasable wedge).
+                    fault = faults.replica_launch_fault()
+                    if fault.delay > 0.0:
+                        time.sleep(fault.delay)
+                    if fault.hang is not None:
+                        fault.hang.wait()  # released by faults.clear/install
+                        if entry.state != "live" or gen != self.gen:
+                            # This generation was retired mid-hang. If
+                            # the watchdog took our batch it was already
+                            # re-dispatched (claim fails, nothing to do);
+                            # but a quarantine triggered by a DIFFERENT
+                            # batch leaves ours live with no one else
+                            # responsible — hand it back to the pool
+                            # rather than stranding its futures until
+                            # (or past, with the watchdog off) expiry.
+                            if pool._claim(entry):
+                                pool._redispatch(
+                                    bucket, reqs,
+                                    ReplicaUnavailable(
+                                        f"replica {self.index} retired "
+                                        "its worker generation mid-hang"
+                                    ),
+                                    count_retry=False,
+                                )
+                            inflight_q.put(_CLOSE)
+                            return
+                    if fault.crash:
+                        raise RuntimeError(
+                            f"injected replica_crash on replica {self.index}"
+                        )
                 n_slots = pool.max_batch
                 exe = self.executables[(bucket, n_slots)]
                 images = [r.image for r in reqs]
@@ -178,92 +374,169 @@ class _Replica:
                     images, bucket, n_slots=n_slots, executable=exe,
                     params=self.params, device=self.device,
                 )
-                bh, bw = bucket
-                pool.stats.record_batch(
-                    n_real=len(reqs),
-                    n_slots=n_slots,
-                    real_px=sum(im.shape[0] * im.shape[1] for im in images),
-                    padded_px=n_slots * bh * bw,
-                    queue_depth=depth,
-                    replica=self.index,
-                    tier=pool.tier,
-                )
-                self.inflight.put((out, reqs, t0))
+                if not probe:
+                    bh, bw = bucket
+                    pool.stats.record_batch(
+                        n_real=len(reqs),
+                        n_slots=n_slots,
+                        real_px=sum(
+                            im.shape[0] * im.shape[1] for im in images
+                        ),
+                        padded_px=n_slots * bh * bw,
+                        queue_depth=depth,
+                        replica=self.index,
+                        tier=pool.tier,
+                    )
+                entry.t0 = t0
+                inflight_q.put((out, entry))
             except BaseException as err:
-                for r in reqs:
-                    if not r.future.done():
-                        r.future.set_exception(err)
-                self._done()
+                pool._on_batch_failure(entry, err, kind="crash")
 
-    def _launch_fallback(self, reqs) -> None:
+    def _launch_fallback(self, reqs, inflight_q, work_q) -> None:
         """Oversize for every bucket: native-shape forwards, one request
         each (mixed oversize shapes cannot stack). These go through the
         engine's jit cache on its default device, so any compile they
         cause is real — count it (stats.compiles is "executables built",
-        warmup AND fallback). Always runs on replica 0, which keeps the
-        cache-size probe single-threaded and race-free."""
+        warmup AND fallback). Routed to the lowest-index available
+        replica; because quarantine can move that routing mid-stream
+        (two launch threads could interleave their before/after cache
+        probes on the shared engine), the probe+dispatch bracket is
+        serialized under the pool's fallback lock — dispatch is async,
+        so the lock never covers device compute or D2H."""
         pool = self.pool
-        for r in reqs:
+        # ONE request per work item: the rest of a group goes back on our
+        # queue as a fresh item, where it stays visible to the supervisor
+        # — not-yet-started requests held in this thread's locals would
+        # be invisible to generation retirement if this launch wedges,
+        # stranding their futures and leaking outstanding counts.
+        r, rest = reqs[0], list(reqs[1:])
+        if rest:
+            work_q.put((None, rest, 0, False))
+        # Take the accounting lock BEFORE registering the watchdog entry:
+        # time spent waiting behind another replica's fallback must not
+        # count against this batch's deadline — otherwise one wedged
+        # fallback would cascade false hang-quarantines through every
+        # replica queued on the lock. The bound is sized to FALLBACK
+        # compile scale (a first-time native-shape compile legitimately
+        # runs minutes — the same reason fallback_watchdog_sec defaults
+        # to exempt), so only a genuine wedge ever trips it: past it we
+        # launch WITHOUT the compile-count bracket (availability over
+        # accounting).
+        fb_wd = pool.supervision.fallback_watchdog_sec
+        locked = pool._fallback_lock.acquire(
+            timeout=fb_wd if fb_wd is not None else 600.0
+        )
+        entry = pool._register(self, None, [r], False)
+        try:
             try:
                 pool.stats.record_fallback()
-                before = engine_jit_cache_size(pool.engine)
+                before = (
+                    engine_jit_cache_size(pool.engine) if locked else None
+                )
                 t0 = time.perf_counter()
                 out = pool.engine.enhance_async(r.image[None])
-                grew = engine_jit_cache_size(pool.engine) - before
-                if grew > 0:
-                    pool.stats.record_compile(grew)
-                self.inflight.put((out, [r], t0))
-            except BaseException as err:
-                if not r.future.done():
-                    r.future.set_exception(err)
-                self._done()
+                if locked:
+                    grew = engine_jit_cache_size(pool.engine) - before
+                    if grew > 0:
+                        pool.stats.record_compile(grew)
+            finally:
+                # Released before the bounded inflight put: D2H
+                # backpressure must never be felt through the lock.
+                if locked:
+                    pool._fallback_lock.release()
+                    locked = False
+            entry.t0 = t0
+            inflight_q.put((out, entry))
+        except BaseException as err:
+            pool._on_batch_failure(entry, err, kind="crash")
 
     # -- completion side -----------------------------------------------
 
-    def _complete_loop(self) -> None:
+    def _complete_loop(self, inflight_q) -> None:
         pool = self.pool
         while True:
-            item = self.inflight.get()
+            item = inflight_q.get()
             if item is _CLOSE:
                 return
-            out_dev, reqs, t0 = item
+            out_dev, entry = item
             try:
-                arr = ten2arr(out_dev)  # this replica's one D2H sync
+                raw = np.asarray(out_dev)  # this replica's one D2H sync
             except BaseException as err:
-                for r in reqs:
-                    if not r.future.done():
-                        r.future.set_exception(err)
-                self._done()
+                pool._on_batch_failure(entry, err, kind="crash")
                 continue
+            if not entry.probe:
+                # nan_output@K: poison the host copy on cue so the guard
+                # below is deterministically testable.
+                raw = faults.poison_replica_output(raw)
+            if pool.supervision.output_guard and not _output_ok(
+                raw, entry.reqs
+            ):
+                pool._on_batch_failure(
+                    entry,
+                    BadOutput(
+                        f"replica {self.index} produced a non-finite or "
+                        "all-zero output canvas"
+                    ),
+                    kind="bad_output",
+                )
+                continue
+            if not pool._claim(entry):
+                # The watchdog aborted this batch while we were syncing
+                # and its requests were re-dispatched elsewhere — discard
+                # the late result (single delivery; byte-identical either
+                # way).
+                continue
+            arr = ten2arr(raw)
             t_done = time.perf_counter()
-            for i, r in enumerate(reqs):
+            if entry.probe:
+                entry.reqs[0].future.set_result(True)
+                continue
+            for i, r in enumerate(entry.reqs):
+                if r.future.done():
+                    continue
                 h, w = r.image.shape[:2]
                 r.future.set_result(arr[i, :h, :w])
                 pool.stats.record_latency(
                     t_done - r.t_submit, replica=self.index, tier=pool.tier
                 )
-            pool.stats.record_replica_busy(self.index, t_done - t0)
-            self._done()
+            if entry.t0 is not None:
+                pool.stats.record_replica_busy(self.index, t_done - entry.t0)
 
-    def _done(self) -> None:
-        with self.pool._lock:
-            self.outstanding -= 1
-
-    def join(self, timeout: float) -> None:
-        self._launcher.join(timeout=timeout)
-        self._completer.join(timeout=timeout)
+def _output_ok(raw: np.ndarray, reqs) -> bool:
+    """The output sanity guard: False for non-finite values (a NaN that
+    crept through the forward) or an all-zero canvas (a transfer that
+    delivered an unwritten buffer) — the two cheap whole-batch
+    signatures of device corruption. One float64 sum is the whole fast
+    path: NaN/Inf propagate through it (no canvas-sized bool temporary
+    like ``np.isfinite(raw).all()`` would allocate), outputs are bounded
+    so the f64 accumulation cannot overflow, and a nonzero sum proves a
+    nonzero canvas. The element scans only run on the rare zero-sum
+    path. The all-zero arm only fires when some INPUT pixel was nonzero:
+    a legitimately all-black frame maps to an all-black enhancement, and
+    quarantining a healthy replica over it (then failing the request
+    after byte-identical retries) would turn one dark upload into an
+    availability incident."""
+    total = np.sum(raw, dtype=np.float64)
+    if not np.isfinite(total):
+        return False
+    if total != 0.0:
+        return True
+    if raw.any():  # exact cancellation of signed values: nonzero canvas
+        return True
+    return not any(r.image.any() for r in reqs)
 
 
 class ReplicaPool:
     """Place the serving executable grid on ``n_replicas`` local devices
-    and multiplex dispatched micro-batches over them.
+    and multiplex dispatched micro-batches over them, under supervision.
 
     Warmup compiles the full ``len(ladder) x len(batch_sizes) x
     n_replicas`` executable grid before construction returns, fanning the
     per-device compiles out over threads (serving/warmup.py) — no request
     ever pays a compile, on any replica, and the engine's jit caches
     never grow mid-serve (the PR-4 sentinel guarantee, now
-    ``len(buckets) x replicas`` executables).
+    ``len(buckets) x replicas`` executables — re-warm probes REUSE the
+    grid, so quarantine cycles never compile either).
     """
 
     def __init__(
@@ -276,6 +549,7 @@ class ReplicaPool:
         stats: Optional[ServingStats] = None,
         warmup_verbose: bool = False,
         tier: str = "quality",
+        supervision: Optional[SupervisionConfig] = None,
     ):
         import jax
 
@@ -302,6 +576,7 @@ class ReplicaPool:
         self.max_inflight = int(max_inflight_per_replica)
         self.stats = stats if stats is not None else ServingStats()
         self.stats.set_replicas(n_replicas)
+        self.supervision = supervision if supervision is not None else SupervisionConfig()
         # Which serving tier this pool's batches/requests count under
         # (docs/SERVING.md "Quality tiers"): "quality" for the PR-4/5
         # teacher pipeline, "fast" for the CAN-student pool a tier-routing
@@ -309,7 +584,16 @@ class ReplicaPool:
         self.tier = str(tier)
         self.stats.declare_tier(self.tier)
         self._lock = threading.Lock()
+        # Serializes the oversize-fallback jit-cache probe bracket: the
+        # lowest-AVAILABLE-index routing can move across replicas during
+        # a quarantine window, and two interleaved before/after cache
+        # probes would mis-count compiles.
+        self._fallback_lock = threading.Lock()
         self._closed = False
+        self._watch: set = set()  # live _Inflight entries (watchdog scope)
+        self._old_threads: List[threading.Thread] = []
+        self.leaked_threads: List[str] = []
+        self._probe_bucket = min(ladder, key=lambda b: b[0] * b[1])
         # A single replica keeps the engine's default placement (device
         # None) — byte-for-byte the PR-4 single-device behavior, and the
         # only valid form for sharded engines.
@@ -326,28 +610,414 @@ class ReplicaPool:
             r.executables = grids[r.index]
         for r in self._replicas:
             r.start()
+        self._stop_supervisor = threading.Event()
+        self._supervisor = threading.Thread(
+            target=self._supervise,
+            name=f"{THREAD_PREFIX}-serve-supervisor-{self.tier}",
+            daemon=True,
+        )
+        self._supervisor.start()
 
     @property
     def n_replicas(self) -> int:
         return len(self._replicas)
 
+    def health(self) -> Dict[int, str]:
+        """Live per-replica health states, by index."""
+        with self._lock:
+            return {r.index: r.state for r in self._replicas}
+
+    # -- dispatch ------------------------------------------------------
+
+    def _pick_replica(self, bucket, exclude=None) -> _Replica:
+        """Least-loaded available replica (lowest index on ties; lowest
+        available index for fallback groups), preferring any replica
+        other than ``exclude``. Caller holds the pool lock. Raises
+        :class:`ReplicaUnavailable` when everything is quarantined."""
+        avail = [r for r in self._replicas if r.state in AVAILABLE_STATES]
+        if not avail:
+            raise ReplicaUnavailable(
+                f"all {len(self._replicas)} replica(s) of the "
+                f"{self.tier!r} pool are quarantined"
+            )
+        others = [r for r in avail if r is not exclude]
+        pool = others or avail
+        if bucket is None:
+            return min(pool, key=lambda r: r.index)
+        return min(pool, key=lambda r: (r.outstanding, r.index))
+
     def dispatch(self, bucket: Optional[Bucket], reqs, queue_depth: int = 0) -> None:
         """Route one coalesced micro-batch (or a fallback group for
-        ``bucket is None``) to the least-loaded replica. Never blocks:
-        work queues are unbounded — the per-replica in-flight bound
-        throttles device memory, not the dispatcher."""
+        ``bucket is None``) to the least-loaded available replica. Never
+        blocks: work queues are unbounded — the per-replica in-flight
+        bound throttles device memory, not the dispatcher. Raises
+        :class:`ReplicaUnavailable` when every replica is quarantined
+        (the batcher turns that into per-request errors; the front door
+        has been answering 503 on /healthz since the last quarantine)."""
         if not reqs:
             return
         with self._lock:
-            if bucket is None:
-                replica = self._replicas[0]
-            else:
-                replica = min(
-                    self._replicas, key=lambda r: (r.outstanding, r.index)
-                )
+            replica = self._pick_replica(bucket)
             # Fallback groups launch one forward per request.
             replica.outstanding += len(reqs) if bucket is None else 1
-        replica.work.put((bucket, reqs, queue_depth))
+            replica.work.put((bucket, reqs, queue_depth, False))
+
+    # -- supervision core ----------------------------------------------
+
+    def _register(self, replica, bucket, reqs, probe) -> _Inflight:
+        """A launch thread started work on a batch: put it under watchdog
+        supervision. Oversize fallbacks (``bucket is None``) use the
+        separate ``fallback_watchdog_sec`` (default None = exempt): their
+        launch blocks on a legitimate first-time XLA compile of the
+        native shape, which any bucketed-batch-sized watchdog would
+        misread as a hang — see :class:`SupervisionConfig` for the
+        tradeoff."""
+        wd = (
+            self.supervision.fallback_watchdog_sec
+            if bucket is None
+            else self.supervision.watchdog_sec
+        )
+        deadline = None if wd is None else time.perf_counter() + wd
+        entry = _Inflight(replica, bucket, reqs, deadline, probe)
+        with self._lock:
+            self._watch.add(entry)
+        return entry
+
+    def _claim(self, entry: _Inflight) -> bool:
+        """Atomically take ownership of a live batch (exactly one of:
+        the completer delivering it, a failure handler retrying it, or
+        the watchdog aborting it wins). False means someone else already
+        owns it — the caller must discard its copy."""
+        with self._lock:
+            if entry.state != "live":
+                return False
+            entry.state = "claimed"
+            self._watch.discard(entry)
+            if not entry.probe:
+                entry.replica.outstanding -= 1
+            return True
+
+    def _on_batch_failure(self, entry: _Inflight, err, kind: str) -> None:
+        """A batch demonstrably failed (launch raised, D2H raised, or
+        the output guard rejected the result): record the strike on its
+        replica and transparently re-dispatch its requests."""
+        if not self._claim(entry):
+            return  # the watchdog already took it (hang abort)
+        replica = entry.replica
+        if entry.probe:
+            if not entry.reqs[0].future.done():
+                entry.reqs[0].future.set_exception(err)
+            return
+        if kind == "bad_output":
+            self.stats.record_nan_output()
+        if entry.bucket is None:
+            # Oversize fallbacks run on the ENGINE'S DEFAULT device
+            # regardless of which replica's launch thread carried them:
+            # their failure says nothing about that replica's health, so
+            # no strike and no exclusion — the bounded retry (same
+            # device, transient faults only) is all re-dispatch can buy.
+            self._redispatch(entry.bucket, entry.reqs, err)
+            return
+        with self._lock:
+            if kind == "bad_output":
+                replica.bad_outputs += 1
+            else:
+                replica.crashes += 1
+            if replica.state == HEALTHY:
+                # One strike -> suspect; the supervisor quarantines and
+                # re-warms on its next scan. (A quarantined/rewarming
+                # replica can still report failures from batches launched
+                # before the transition — those stay where they are.)
+                replica.state = SUSPECT
+        self._redispatch(entry.bucket, entry.reqs, err, exclude=replica)
+
+    def _redispatch(
+        self, bucket, reqs, err, count_retry: bool = True, exclude=None
+    ) -> None:
+        """Re-queue requests from a failed (or never-started, when
+        ``count_retry=False``) batch onto a surviving replica —
+        ``exclude`` (the replica that just failed, usually still only
+        SUSPECT and therefore available) is avoided whenever any other
+        replica can take the work, so a persistently sick device cannot
+        burn the whole retry budget before the supervisor's next scan
+        quarantines it. Bounded by the per-request retry budget. Results
+        are byte-identical to a first-try serve (replica invariance),
+        and only demonstrably failed work ever gets here — successes are
+        never recomputed (the claim protocol). Requests whose deadline
+        passed while their batch was failing are dropped here with the
+        same un-computed-504 policy the dispatcher applies at flush — a
+        response nobody waits for is wasted device time, and the retry
+        path must not be the one door that serves dead work late."""
+        now = time.perf_counter()
+        live: List = []
+        for r in reqs:
+            if r.future.done():
+                continue
+            deadline = getattr(r, "deadline", None)
+            if deadline is not None and deadline <= now:
+                from waternet_tpu.serving.batcher import DeadlineExpired
+
+                self.stats.record_deadline_expired()
+                r.future.set_exception(
+                    DeadlineExpired(
+                        "deadline expired while the batch was being "
+                        "retried; dropped un-computed"
+                    )
+                )
+                continue
+            live.append(r)
+        if not live:
+            return
+        retryable: List = []
+        for r in live:
+            if count_retry:
+                r.retries = getattr(r, "retries", 0) + 1
+            if getattr(r, "retries", 0) <= self.supervision.max_retries:
+                retryable.append(r)
+            else:
+                if not r.future.done():
+                    r.future.set_exception(err)
+        if not retryable:
+            return
+        try:
+            with self._lock:
+                replica = self._pick_replica(bucket, exclude=exclude)
+                replica.outstanding += (
+                    len(retryable) if bucket is None else 1
+                )
+                replica.work.put((bucket, retryable, 0, False))
+            if count_retry:
+                self.stats.record_retry(len(retryable))
+        except ReplicaUnavailable as unavailable:
+            final = unavailable if err is None else err
+            for r in retryable:
+                if not r.future.done():
+                    r.future.set_exception(final)
+
+    def _retire_generation(self, replica: _Replica):
+        """Replace a replica's current worker generation (caller holds
+        the pool lock): bump ``gen`` so a later-waking wedged thread
+        knows to exit, spawn fresh threads on fresh queues, keep the old
+        threads joinable for :meth:`close`, and drain the old work queue
+        — adjusting ``outstanding`` for dispatched (non-probe) items.
+        Returns ``(old_work_queue, drained_items)``; the caller must put
+        ``_CLOSE`` on the old queue AFTER releasing the lock and dispose
+        of the drained items (re-dispatch vs fail, depending on why the
+        generation died)."""
+        replica.gen += 1
+        old_work, old_threads = replica.respawn()
+        self._old_threads.extend(old_threads)
+        drained: List = []
+        try:
+            while True:
+                item = old_work.get_nowait()
+                if item is _CLOSE:
+                    continue
+                drained.append(item)
+                if not item[3]:  # probes never count toward outstanding
+                    replica.outstanding -= (
+                        len(item[1]) if item[0] is None else 1
+                    )
+        except queue.Empty:
+            pass
+        return old_work, drained
+
+    def _quarantine(self, replica: _Replica, reason: str) -> None:
+        """Take a replica out of rotation: bump its worker generation
+        (retiring possibly-wedged threads), drain its never-started work
+        back to the pool, and schedule a re-warm probe. In-flight batches
+        keep their watchdog entries — a live one either completes through
+        the old completer (claims still win) or expires and re-dispatches."""
+        with self._lock:
+            # No generation churn once close() latched _closed (both
+            # hold this lock): a respawn here would create fresh threads
+            # close() never sees — an unjoined, unreported leak.
+            if self._closed or replica.state in (QUARANTINED, REWARMING):
+                return
+            replica.state = QUARANTINED
+            replica.quarantines += 1
+            now = time.perf_counter()
+            replica._quarantined_at = now
+            replica._rewarm_backoff = self.supervision.rewarm_backoff_sec
+            replica._next_rewarm_at = now + replica._rewarm_backoff
+            replica._probe = None
+            old_work, stranded = self._retire_generation(replica)
+        old_work.put(_CLOSE)  # retire an idle (non-wedged) old launcher
+        self.stats.record_quarantine()
+        for bucket, reqs, _depth, _probe in stranded:
+            # Never-started work: re-route without burning retry budget
+            # (nothing was computed, nothing demonstrably failed).
+            self._redispatch(
+                bucket, reqs,
+                ReplicaUnavailable(
+                    f"replica {replica.index} quarantined ({reason}) with "
+                    "queued work and no surviving replica"
+                ),
+                count_retry=False,
+            )
+
+    def _supervise(self) -> None:
+        while not self._stop_supervisor.wait(
+            self.supervision.scan_interval_sec
+        ):
+            try:
+                self._supervise_once()
+            except Exception as err:  # pragma: no cover - defensive
+                print(
+                    f"ReplicaPool supervisor error ({self.tier}): "
+                    f"{type(err).__name__}: {err}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+
+    def _supervise_once(self) -> None:
+        now = time.perf_counter()
+        expired: List[_Inflight] = []
+        with self._lock:
+            if self._closed:
+                return
+            for e in list(self._watch):
+                if (
+                    e.state == "live"
+                    and e.deadline is not None
+                    and e.deadline <= now
+                ):
+                    e.state = "aborted"
+                    self._watch.discard(e)
+                    if not e.probe:
+                        e.replica.outstanding -= 1
+                    expired.append(e)
+        for e in expired:
+            r = e.replica
+            if e.probe:
+                # The re-warm probe itself hung: the device is still
+                # sick. The fresh launcher is now wedged on it, so a
+                # respawn is mandatory — without one, the next probe
+                # would queue behind the wedged thread forever and the
+                # replica would strand in REWARMING.
+                self._probe_failed(r, now, respawn=True)
+                if not e.reqs[0].future.done():
+                    e.reqs[0].future.set_exception(
+                        ReplicaUnavailable("re-warm probe timed out")
+                    )
+                continue
+            if e.bucket is None:
+                # A hung OVERSIZE FALLBACK (fallback_watchdog_sec armed):
+                # the wedge is the carrier THREAD and the engine's
+                # default device — like fallback crashes, it says
+                # nothing about this replica's health. Replace the
+                # worker generation (freeing the queued work behind the
+                # wedged launcher) WITHOUT a quarantine strike, and
+                # requeue everything.
+                with self._lock:
+                    if self._closed:
+                        continue
+                    old_work, drained = self._retire_generation(r)
+                old_work.put(_CLOSE)
+                for item in drained:
+                    self._redispatch(
+                        item[0], item[1],
+                        ReplicaUnavailable(
+                            "work retired behind a hung oversize fallback"
+                        ),
+                        count_retry=False,
+                    )
+                self._redispatch(
+                    e.bucket, e.reqs,
+                    ReplicaUnavailable(
+                        "oversize fallback hung past "
+                        f"fallback_watchdog_sec="
+                        f"{self.supervision.fallback_watchdog_sec}"
+                    ),
+                )
+                continue
+            with self._lock:
+                r.hangs += 1
+            self._quarantine(r, reason="hang")
+            self._redispatch(
+                e.bucket, e.reqs,
+                ReplicaUnavailable(
+                    f"replica {r.index} hung past the "
+                    f"{self.supervision.watchdog_sec}s watchdog"
+                ),
+                exclude=r,
+            )
+        # Promote suspects to quarantine (their failed batch already
+        # re-dispatched in _on_batch_failure).
+        for r in self._replicas:
+            if r.state == SUSPECT:
+                self._quarantine(r, reason="crash")
+        # Re-warm due quarantined replicas; reintegrate finished probes.
+        for r in self._replicas:
+            if r.state == QUARANTINED and now >= r._next_rewarm_at:
+                self._start_probe(r)
+            elif r.state == REWARMING and r._probe is not None and r._probe.done():
+                if r._probe.exception() is None:
+                    self._reintegrate(r)
+                else:
+                    # The probe raised (launcher alive): back off and
+                    # retry later — no respawn needed.
+                    self._probe_failed(r, now, respawn=False)
+
+    def _probe_failed(self, replica: _Replica, now: float, respawn: bool) -> None:
+        """A re-warm probe hung (``respawn=True`` — its launcher is
+        wedged and must be replaced) or raised (``respawn=False``): stay
+        quarantined with a doubled backoff, ready for the next probe."""
+        stale_probes: List = []
+        with self._lock:
+            if self._closed:
+                return  # close() owns thread lifecycle from here on
+            if replica.state == REWARMING:
+                replica.state = QUARANTINED
+            replica._rewarm_backoff = min(
+                max(replica._rewarm_backoff, self.supervision.rewarm_backoff_sec) * 2,
+                self.supervision.max_rewarm_backoff_sec,
+            )
+            replica._next_rewarm_at = now + replica._rewarm_backoff
+            replica._probe = None
+            if respawn:
+                old_work, drained = self._retire_generation(replica)
+                # A quarantined replica's queue only ever holds probes;
+                # fail any stale ones rather than re-routing them.
+                for item in drained:
+                    stale_probes.extend(item[1])
+        if respawn:
+            old_work.put(_CLOSE)
+        for p in stale_probes:
+            if not p.future.done():
+                p.future.set_exception(
+                    ReplicaUnavailable("stale re-warm probe retired")
+                )
+
+    def _start_probe(self, replica: _Replica) -> None:
+        """Push one watchdog-guarded probe batch through the replica's
+        fresh threads and EXISTING executables (reused — zero compiles,
+        which is what keeps the compile sentinel green across quarantine
+        cycles)."""
+        req = _ProbeRequest(probe_image(self._probe_bucket))
+        with self._lock:
+            if self._closed or replica.state != QUARANTINED:
+                return
+            replica.state = REWARMING
+            replica._probe = req.future
+            replica.work.put((self._probe_bucket, [req], 0, True))
+
+    def _reintegrate(self, replica: _Replica) -> None:
+        with self._lock:
+            if replica.state != REWARMING:
+                return
+            replica.state = HEALTHY
+            replica.reintegrations += 1
+            replica._probe = None
+            recovery = (
+                time.perf_counter() - replica._quarantined_at
+                if replica._quarantined_at is not None
+                else 0.0
+            )
+            replica._quarantined_at = None
+        self.stats.record_reintegration(recovery)
+
+    # -- params / lifecycle --------------------------------------------
 
     def set_params(self, params) -> None:
         """Hot weight reload: place ``params`` on every replica's device
@@ -358,23 +1028,45 @@ class ReplicaPool:
         runs entirely on old or entirely on new weights — in-flight
         batches complete on the params they were launched with, and no
         request is dropped. The engine's own params swap too, so oversize
-        fallbacks (replica 0's jit-cache path) serve the new weights as
-        well. Callers validate tree structure / shapes / dtypes first
-        (the AOT executables were lowered against them); see
-        serving/server.py's reload endpoint.
+        fallbacks (the jit-cache path) serve the new weights as well.
+        Callers validate tree structure / shapes / dtypes first (the AOT
+        executables were lowered against them); see serving/server.py's
+        reload endpoint.
         """
         self.engine.params = params
         for r in self._replicas:
             r.params = self.engine.replica_params(r.device)
 
-    def close(self) -> None:
-        """Drain every replica's queued work, stop and join all worker
-        threads. Idempotent; safe from ``finally``."""
+    def close(self, timeout: float = 60.0) -> List[str]:
+        """Drain every replica's queued work, stop the supervisor and all
+        worker threads, and join them. A thread that fails to join within
+        ``timeout`` (wedged in device work — the watchdog's quarry) is
+        reported **loudly** on stderr and returned by name, never
+        silently leaked: the caller (and the test suite's thread-leak
+        guard) can see exactly which worker is stuck. Idempotent; safe
+        from ``finally``."""
         with self._lock:
             if self._closed:
-                return
+                return list(self.leaked_threads)
             self._closed = True
+        self._stop_supervisor.set()
+        threads: List[threading.Thread] = [self._supervisor]
         for r in self._replicas:
             r.work.put(_CLOSE)
-        for r in self._replicas:
-            r.join(timeout=60.0)
+            threads.extend([r._launcher, r._completer])
+        threads.extend(self._old_threads)
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        leaked = [t.name for t in threads if t.is_alive()]
+        self.leaked_threads = leaked
+        if leaked:
+            print(
+                f"ReplicaPool.close ({self.tier}): {len(leaked)} worker "
+                f"thread(s) failed to join within {timeout:.1f}s — wedged "
+                f"in device work and cannot be interrupted, only "
+                f"abandoned: {leaked}",
+                file=sys.stderr,
+                flush=True,
+            )
+        return leaked

@@ -34,9 +34,23 @@ for the traffic patterns a library never sees:
   executables take params as a runtime argument, so a valid reload
   never recompiles — the compile-sentinel guarantee holds across it.
 * **Readiness + observability.** ``GET /healthz`` reports ready only
-  after AOT warmup completes (and not-draining); ``GET /stats`` exposes
-  the live :class:`ServingStats` schema (docs/SERVING.md), including
-  ``queue_depth`` / ``shed_count`` / ``deadline_expired``.
+  after AOT warmup completes (and not-draining), and carries the
+  replica-supervision verdict: ``ok`` / ``degraded`` (some replicas
+  quarantined, still 200 — the pool is serving) / ``unhealthy`` (a tier
+  with zero available replicas, 503), with the per-tier
+  ``{replica: state}`` map. ``GET /stats`` exposes the live
+  :class:`ServingStats` schema (docs/SERVING.md), including
+  ``queue_depth`` / ``shed_count`` / ``deadline_expired`` and the
+  fault-isolation counters (``retried`` / ``downgraded`` /
+  ``quarantines`` / ``reintegrations`` / ``nan_outputs``).
+* **Fault isolation + brown-out.** The batcher's replica pools run under
+  supervision (docs/SERVING.md "Fault isolation"): a crashing or hung
+  replica is quarantined, its requests transparently re-dispatched
+  (byte-identical results), and the replica re-warmed and reintegrated.
+  Quality requests that opt in via ``X-Tier-Allow-Downgrade: 1`` are
+  served by the fast tier instead of shed once the queue passes the
+  downgrade watermark; ``X-Tier-Served`` on the response names the tier
+  that actually served.
 
 Endpoints: ``POST /enhance`` (image file bytes in, PNG out — the body
 is whatever ``cv2.imdecode`` reads, which is exactly what ``cv2.imread``
@@ -72,6 +86,11 @@ from waternet_tpu.serving.batcher import (
     QueueFull,
     UnknownTier,
     resolve_ladder,
+)
+from waternet_tpu.serving.replicas import (
+    AVAILABLE_STATES,
+    ReplicaUnavailable,
+    SupervisionConfig,
 )
 from waternet_tpu.serving.stats import ServingStats
 
@@ -170,11 +189,18 @@ class ServingServer:
         min_deadline_ms: float = 0.0,
         stats: Optional[ServingStats] = None,
         fast_engine=None,
+        supervision: Optional[SupervisionConfig] = None,
+        downgrade_watermark: Optional[int] = None,
     ):
         if admit_watermark is None:
             # Shed before QueueFull would fire: the watermark is the soft
             # limit with headroom for requests already racing past it.
             admit_watermark = max(1, (3 * max_queue) // 4)
+        if downgrade_watermark is None:
+            # Brown-out trips where shedding would: an opted-in quality
+            # request at the admit watermark downgrades instead of 429ing
+            # (only meaningful with a fast engine configured).
+            downgrade_watermark = admit_watermark
         self.engine = engine
         self.fast_engine = fast_engine
         self.ladder = ladder
@@ -187,6 +213,8 @@ class ServingServer:
         self.admit_watermark = int(admit_watermark)
         self.grace_sec = float(grace_sec)
         self.min_deadline_ms = float(min_deadline_ms)
+        self.supervision = supervision
+        self.downgrade_watermark = int(downgrade_watermark)
         self.stats = stats if stats is not None else ServingStats()
         self.batcher: Optional[DynamicBatcher] = None
         self.bound_port: Optional[int] = None
@@ -286,6 +314,8 @@ class ServingServer:
                     replicas=self.replicas,
                     max_queue=self.max_queue,
                     fast_engine=self.fast_engine,
+                    supervision=self.supervision,
+                    downgrade_watermark=self.downgrade_watermark,
                 )
 
             loop = asyncio.get_running_loop()
@@ -453,13 +483,43 @@ class ServingServer:
         return self._json(writer, 404, {"error": f"no route {path}"})
 
     def _healthz(self, writer) -> bool:
+        """Readiness + replica health (docs/SERVING.md "Fault
+        isolation"): ``ok`` when every replica of every tier is
+        available; ``degraded`` (still 200 — the pool is serving) when
+        some replicas are quarantined/re-warming but every tier keeps at
+        least one available; ``unhealthy`` (503) when any tier has zero
+        available replicas. Warming and draining stay 503 as before."""
         ready = self.ready.is_set() and not self.draining.is_set()
         payload = {
             "ready": ready,
             "warmed": self.ready.is_set(),
             "draining": self.draining.is_set(),
         }
-        return self._json(writer, 200 if ready else 503, payload)
+        if not self.ready.is_set():
+            payload["status"] = "warming"
+            return self._json(writer, 503, payload)
+        health = self.batcher.health()  # {tier: {index: state}}
+        payload["replicas"] = {
+            t: {str(i): s for i, s in sorted(m.items())}
+            for t, m in sorted(health.items())
+        }
+        tier_available = {
+            t: any(s in AVAILABLE_STATES for s in m.values())
+            for t, m in health.items()
+        }
+        any_sick = any(
+            s not in AVAILABLE_STATES for m in health.values()
+            for s in m.values()
+        )
+        if self.draining.is_set():
+            payload["status"] = "draining"
+            return self._json(writer, 503, payload)
+        if not all(tier_available.values()):
+            payload["ready"] = False  # a tier with zero available replicas
+            payload["status"] = "unhealthy"
+            return self._json(writer, 503, payload)
+        payload["status"] = "degraded" if any_sick else "ok"
+        return self._json(writer, 200, payload)
 
     # -- /enhance ------------------------------------------------------
 
@@ -502,6 +562,19 @@ class ServingServer:
                     "tiers": list(self.batcher.tiers),
                 },
             )
+        # Brown-out opt-in (docs/SERVING.md "Fault isolation"): an
+        # X-Tier-Allow-Downgrade'd quality request under saturation is
+        # served by the fast tier instead of shed; the response names the
+        # tier that actually served via X-Tier-Served. Never applied
+        # without the opt-in.
+        allow_downgrade = headers.get(
+            "x-tier-allow-downgrade", ""
+        ).strip().lower() in ("1", "true", "yes")
+        downgrade_eligible = (
+            allow_downgrade
+            and tier == "quality"
+            and "fast" in self.batcher.tiers
+        )
 
         # Deadline parse + up-front feasibility: a budget the server
         # already knows it cannot meet is refused before it queues.
@@ -539,13 +612,25 @@ class ServingServer:
             )
         depth = self.batcher.queue_depth()
         if depth >= self.admit_watermark:
-            self.stats.record_shed()
-            return self._json(
-                writer,
-                429,
-                {"error": "overloaded", "queue_depth": depth},
-                extra=(("Retry-After", "1"),),
+            # Brown-out exemption ONLY when the downgrade will actually
+            # fire (the batcher's gauge is the QUALITY-tier backlog):
+            # under a fast-tier flood the quality backlog is small, no
+            # downgrade would happen, and admitting past the watermark
+            # would just queue to QueueFull — shed instead.
+            will_downgrade = (
+                downgrade_eligible
+                and self.batcher.downgrade_watermark is not None
+                and self.batcher.tier_depth("quality")
+                >= self.batcher.downgrade_watermark
             )
+            if not will_downgrade:
+                self.stats.record_shed()
+                return self._json(
+                    writer,
+                    429,
+                    {"error": "overloaded", "queue_depth": depth},
+                    extra=(("Retry-After", "1"),),
+                )
 
         loop = asyncio.get_running_loop()
         # In-flight from BEFORE the decode: the drain poll must not see
@@ -562,7 +647,10 @@ class ServingServer:
                     writer, 400, {"error": "body is not a decodable image"}
                 )
             try:
-                fut = self.batcher.submit(rgb, deadline=deadline, tier=tier)
+                fut = self.batcher.submit(
+                    rgb, deadline=deadline, tier=tier,
+                    allow_downgrade=allow_downgrade,
+                )
             except UnknownTier as err:
                 return self._json(writer, 400, {"error": str(err)})
             except QueueFull as err:
@@ -584,12 +672,25 @@ class ServingServer:
                 out = await asyncio.wrap_future(fut)
             except DeadlineExpired as err:
                 return self._json(writer, 504, {"error": str(err)})
+            except ReplicaUnavailable as err:
+                # Every replica quarantined (healthz has been reporting
+                # unhealthy): tell clients to come back, not that the
+                # request was malformed.
+                return self._json(
+                    writer,
+                    503,
+                    {"error": str(err)},
+                    extra=(("Retry-After", "1"),),
+                )
             except Exception as err:
                 return self._json(
                     writer, 500, {"error": f"{type(err).__name__}: {err}"}
                 )
             png = await loop.run_in_executor(None, _encode_response_png, out)
-            keep = self._respond(writer, 200, png, ctype="image/png")
+            keep = self._respond(
+                writer, 200, png, ctype="image/png",
+                extra=(("X-Tier-Served", getattr(fut, "tier", tier)),),
+            )
             # Flush before the in-flight decrement: the drain poll must
             # not declare the server empty while this response is still
             # in the transport's user-space buffer — asyncio.run would
@@ -748,6 +849,26 @@ def parse_args(argv=None):
         help="Run WB/GC/CLAHE on the accelerator (ops/masked.py).",
     )
     parser.add_argument(
+        "--watchdog-sec", type=float, default=30.0,
+        help="Per-batch watchdog: a replica whose batch stays in flight "
+        "past this is declared hung, quarantined, and its requests "
+        "re-dispatched onto surviving replicas (docs/SERVING.md 'Fault "
+        "isolation'). 0 disables the watchdog (crash isolation remains).",
+    )
+    parser.add_argument(
+        "--serve-max-retries", type=int, default=2,
+        help="Per-request re-dispatch budget after demonstrable batch "
+        "failures (crash / hang / bad output); past it the request "
+        "errors out.",
+    )
+    parser.add_argument(
+        "--downgrade-watermark", type=int, default=None,
+        help="Queue depth past which a quality request that opted in "
+        "(X-Tier-Allow-Downgrade: 1) is served by the fast tier instead "
+        "of shed (default: --admit-watermark). Needs --student-weights; "
+        "never applied to requests that didn't opt in.",
+    )
+    parser.add_argument(
         "--precision", type=str, default="fp32", choices=["fp32", "bf16"],
     )
     return parser.parse_args(argv)
@@ -773,6 +894,13 @@ def main(argv=None) -> int:
         raise SystemExit(
             "--student-quantize needs --student-weights (there is no "
             "student to quantize)"
+        )
+    if args.downgrade_watermark is not None and not args.student_weights:
+        raise SystemExit(
+            "--downgrade-watermark needs --student-weights: brown-out "
+            "downgrades route saturated quality traffic to the fast "
+            "tier, and without a student there is no fast tier to "
+            "downgrade to (docs/SERVING.md 'Fault isolation')"
         )
     engine = InferenceEngine(
         weights=args.weights,
@@ -802,6 +930,13 @@ def main(argv=None) -> int:
         admit_watermark=args.admit_watermark,
         grace_sec=args.grace_sec,
         min_deadline_ms=args.min_deadline_ms,
+        supervision=SupervisionConfig(
+            watchdog_sec=(
+                None if args.watchdog_sec <= 0 else args.watchdog_sec
+            ),
+            max_retries=args.serve_max_retries,
+        ),
+        downgrade_watermark=args.downgrade_watermark,
     )
     return server.run(install_signal_handlers=True)
 

@@ -63,7 +63,19 @@ class ServingStats:
       traffic split under per-request tier routing (docs/SERVING.md
       "Quality tiers"). Every configured tier appears (a served-nothing
       fast tier shows zeros); batchers without a fast engine report the
-      quality tier alone.
+      quality tier alone;
+    * **fault-isolation counters** (docs/SERVING.md "Fault isolation"):
+      ``retried`` — requests re-dispatched onto a surviving replica after
+      their batch demonstrably failed (crash / watchdog hang / bad
+      output; never double-counts a delivered result); ``downgraded`` —
+      opted-in quality requests served by the fast tier under brown-out
+      pressure; ``nan_outputs`` — batches the output sanity guard
+      rejected (non-finite or all-zero canvas); ``quarantines`` /
+      ``reintegrations`` — replica state-machine transitions, with
+      ``recovery_sec_max`` the longest quarantine→reintegration span;
+      ``replica_health`` — the LIVE per-tier ``{replica: state}`` map,
+      read through the probe the owning batcher registers (empty for
+      stats objects nothing registered on).
     """
 
     def __init__(self):
@@ -85,6 +97,17 @@ class ServingStats:
         #: reports 0 — stats objects riding an ExactShapeBatcher or a bare
         #: test have no queue to report.
         self.queue_depth_probe = None
+        #: Live replica-health gauge: a zero-arg callable returning the
+        #: per-tier {replica_index: state} map (DynamicBatcher.health).
+        #: Left None, the summary reports {} — bare stats objects have no
+        #: replica pool to report on.
+        self.replica_health_probe = None
+        self.retried = 0
+        self.downgraded = 0
+        self.nan_outputs = 0
+        self.quarantines = 0
+        self.reintegrations = 0
+        self._recovery_max_s = 0.0
         self._depth_sum = 0
         self.depth_max = 0
         self.replicas = 1
@@ -184,6 +207,40 @@ class ServingStats:
         with self._lock:
             self.deadline_expired += 1
 
+    def record_retry(self, n: int = 1) -> None:
+        """``n`` requests re-dispatched onto a surviving replica after
+        their batch demonstrably failed (crash, watchdog hang, or a
+        guard-rejected output) — counted per re-dispatch, not per
+        delivered result."""
+        with self._lock:
+            self.retried += n
+
+    def record_downgrade(self) -> None:
+        """One opted-in quality request served by the fast tier under
+        brown-out pressure instead of being shed (docs/SERVING.md
+        "Fault isolation")."""
+        with self._lock:
+            self.downgraded += 1
+
+    def record_nan_output(self) -> None:
+        """One completed batch rejected by the output sanity guard
+        (non-finite values or an all-zero canvas after D2H)."""
+        with self._lock:
+            self.nan_outputs += 1
+
+    def record_quarantine(self) -> None:
+        """One replica transitioned into quarantine (crash strikes or a
+        watchdog-detected hang)."""
+        with self._lock:
+            self.quarantines += 1
+
+    def record_reintegration(self, recovery_sec: float = 0.0) -> None:
+        """One quarantined replica re-warmed and reintegrated;
+        ``recovery_sec`` is its quarantine→reintegration span."""
+        with self._lock:
+            self.reintegrations += 1
+            self._recovery_max_s = max(self._recovery_max_s, recovery_sec)
+
     def record_fallback(self) -> None:
         with self._lock:
             self.fallback_native += 1
@@ -278,6 +335,13 @@ class ServingStats:
             shed = self.shed
             expired = self.deadline_expired
             probe = self.queue_depth_probe
+            health_probe = self.replica_health_probe
+            retried = self.retried
+            downgraded = self.downgraded
+            nan_outputs = self.nan_outputs
+            quarantines = self.quarantines
+            reintegrations = self.reintegrations
+            recovery_max = self._recovery_max_s
             tiers = {name: dict(c) for name, c in self._tiers.items()}
         return {
             "requests": requests,
@@ -289,6 +353,15 @@ class ServingStats:
             "fallback_native_shapes": fallback,
             "shed_count": shed,
             "deadline_expired": expired,
+            "retried": retried,
+            "downgraded": downgraded,
+            "nan_outputs": nan_outputs,
+            "quarantines": quarantines,
+            "reintegrations": reintegrations,
+            "recovery_sec_max": round(recovery_max, 3),
+            "replica_health": (
+                health_probe() if health_probe is not None else {}
+            ),
             "queue_depth": int(probe()) if probe is not None else 0,
             "queue_depth_mean": round(depth_mean, 2),
             "queue_depth_max": depth_max,

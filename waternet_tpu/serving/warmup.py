@@ -23,6 +23,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional, Sequence, Tuple
 
+import numpy as np
+
 from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.serving.bucketing import Bucket, BucketLadder
 from waternet_tpu.serving.stats import ServingStats
@@ -31,6 +33,23 @@ from waternet_tpu.serving.stats import ServingStats
 #: full pod-slice host (8 replicas) without turning a many-bucket ladder
 #: into a thread stampede.
 MAX_WARMUP_THREADS = 8
+
+
+def probe_image(bucket: Bucket) -> np.ndarray:
+    """Deterministic uint8 probe canvas at exactly ``bucket`` shape, for
+    replica re-warm (docs/SERVING.md "Fault isolation"): after a
+    quarantine, the supervisor pushes one probe batch through the
+    replica's existing AOT executables — the exact-fit shape means zero
+    pad work and zero compiles (warmup already built the executable; a
+    re-warm REUSES it, which is what keeps the no-mid-serve-compile
+    sentinel green across quarantine cycles). A fixed gradient rather
+    than zeros, so the probe rides the same output-sanity-guard path
+    real batches do without tripping the all-zero-canvas detector on
+    degenerate params."""
+    bh, bw = bucket
+    yy, xx = np.mgrid[0:bh, 0:bw]
+    plane = ((yy * 3 + xx * 5) % 251).astype(np.uint8)
+    return np.repeat(plane[..., None], 3, axis=-1)
 
 
 def warmup(
