@@ -741,6 +741,148 @@ def bench_serving_chaos(
     }
 
 
+def bench_stream(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+    streams=None, frames=None,
+):
+    """Live-stream serving bench (serving/streams.py, docs/SERVING.md
+    "Streaming"): N paced concurrent POST /stream sessions over a real
+    two-tier server, reporting the ROADMAP item 4 contract line
+    ``video_stream_fps``.
+
+    Three phases: a single unpaced calibration stream measures the
+    pipeline's frame capacity; phase A offers real-time load (capacity /
+    2 split across N streams — the sustainable regime; its per-stream
+    fps is the contract value and its p99 end-to-end frame latency is
+    reported against the freshness budget); phase B offers 2x that (the
+    aggregate equals calibrated capacity), where the QoS machinery must
+    choose — ``drop_rate_at_2x`` and ``downgrade_rate_at_2x`` report
+    what it chose. ``accounted`` cross-checks the client-side per-frame
+    ledger against the server's ``/stats`` stream counters, so a
+    silently lost frame reads ``accounted: false``.
+
+    The fast tier is a fresh CAN-student init (rate and policy behavior
+    are weight-independent), with the brown-out watermark low enough
+    that phase B's backlog can actually trip it for the opted-in
+    streams.
+    """
+    import cv2
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.inference_engine import InferenceEngine, StudentEngine
+    from waternet_tpu.models import CANStudent
+    from waternet_tpu.serving import derive_buckets
+    from waternet_tpu.serving.loadgen import run_stream_load
+    from waternet_tpu.serving.server import ServingServer
+
+    n_images, max_batch, max_buckets = _serving_env_defaults(
+        n_images, max_batch, max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+    n_streams = (
+        _env_int("WATERNET_BENCH_STREAMS", 4) if streams is None else streams
+    )
+    n_frames = (
+        _env_int("WATERNET_BENCH_STREAM_FRAMES", 12)
+        if frames is None else frames
+    )
+
+    params = _serving_params()
+    student_params = CANStudent().init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16, 16, 3), jnp.float32)
+    )
+    images, shapes = _serving_population(n_images, base)
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+    payloads = [
+        cv2.imencode(".png", im[:, :, ::-1])[1].tobytes() for im in images
+    ]
+
+    server = ServingServer(
+        InferenceEngine(params=params), ladder,
+        max_batch=max_batch, max_wait_ms=5.0, replicas=1,
+        max_queue=8 * max_batch, admit_watermark=4 * max_batch,
+        fast_engine=StudentEngine(params=student_params),
+        downgrade_watermark=max(2, n_streams),
+        max_streams=2 * n_streams,
+        stream_window=4,
+    )
+    t0 = time.perf_counter()
+    server.start_background()
+    server.wait_ready()
+    warmup_s = time.perf_counter() - t0
+    try:
+        # Calibration: one unpaced stream with generous budget/window —
+        # the pipeline's per-frame capacity, nothing dropped.
+        cal = run_stream_load(
+            server.url, payloads, streams=1, frames=2 * n_frames,
+            fps=500.0, budget_ms=60_000.0, window=64,
+        )
+        cal_fps = max(1.0, cal["fps_per_stream"])
+        real_time_fps = max(0.5, cal_fps / (2 * n_streams))
+        budget_ms = 3000.0 / real_time_fps
+        loaded = run_stream_load(
+            server.url, payloads, streams=n_streams, frames=n_frames,
+            fps=real_time_fps, budget_ms=budget_ms,
+            tier="quality", allow_downgrade=True,
+        )
+        overload = run_stream_load(
+            server.url, payloads, streams=n_streams, frames=n_frames,
+            fps=2 * real_time_fps, budget_ms=budget_ms,
+            tier="quality", allow_downgrade=True,
+        )
+    finally:
+        server.request_drain()
+        server.join()
+    summary = server.stats.summary()
+    st = summary["streams"]
+
+    phases = (cal, loaded, overload)
+    accounted = (
+        st["frames_delivered"] == sum(p["ok"] for p in phases)
+        and st["frames_dropped"] == sum(p["dropped"] for p in phases)
+        and st["frames_out_of_budget"]
+        == sum(p["out_of_budget"] for p in phases)
+        and st["refused"] == sum(p["refused"] for p in phases)
+        and all(p["errors"] == 0 for p in phases)
+        and all(p["conn_reset"] == 0 for p in phases)
+        and all(p["frame_errors"] == 0 for p in phases)
+    )
+    sent_2x = max(1, overload["frames_sent"])
+    return {
+        "metric": "video_stream_fps",
+        "value": loaded["fps_per_stream"],
+        "unit": "fps/stream",
+        "vs_baseline": None,
+        "streams": n_streams,
+        "frames_per_stream": n_frames,
+        "calibrated_fps": round(cal_fps, 2),
+        "offered_fps_per_stream": round(real_time_fps, 2),
+        "budget_ms": round(budget_ms, 1),
+        "p99_frame_ms": loaded["frame_latency_ms"]["p99"],
+        "p99_within_budget": bool(
+            loaded["frame_latency_ms"]["p99"] <= budget_ms
+        ),
+        "drop_rate_at_2x": round(
+            (overload["dropped"] + overload["out_of_budget"]) / sent_2x, 4
+        ),
+        "downgrade_rate_at_2x": round(overload["downgraded"] / sent_2x, 4),
+        "fps_per_stream_at_2x": overload["fps_per_stream"],
+        "accounted": bool(accounted),
+        "frames_delivered": st["frames_delivered"],
+        "frames_dropped": st["frames_dropped"],
+        "frames_out_of_budget": st["frames_out_of_budget"],
+        "stream_downgrades": st["downgrades"],
+        "streams_refused": st["refused"],
+        "compiles": summary["compiles"],
+        "fallback_native_shapes": summary["fallback_native_shapes"],
+        "buckets": ladder.describe(),
+        "warmup_sec": round(warmup_s, 1),
+        "n_images": n_images,
+        "max_batch": max_batch,
+    }
+
+
 def bench_tiers(
     n_images=None, max_batch=None, max_buckets=None, base_hw=None,
 ):
@@ -1467,7 +1609,7 @@ def main():
     parser.add_argument(
         "--config",
         choices=["train", "video", "serve", "serve_multi", "serve_http",
-                 "serve_chaos", "tiers"],
+                 "serve_chaos", "tiers", "stream"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
@@ -1479,9 +1621,12 @@ def main():
         "serve_chaos (closed-loop throughput with one replica crashed "
         "and one hung mid-run: recovery time, retry/downgrade/shed "
         "accounting — docs/SERVING.md 'Fault isolation'), "
-        "or tiers (quality vs fast CAN-student A/B under per-request "
+        "tiers (quality vs fast CAN-student A/B under per-request "
         "tier routing: throughput, FLOP ratio, SSIM-vs-teacher, int8 "
-        "arm — docs/SERVING.md 'Quality tiers')",
+        "arm — docs/SERVING.md 'Quality tiers'), "
+        "or stream (N paced concurrent POST /stream sessions: sustained "
+        "fps/stream, p99 frame latency vs budget, drop/downgrade rate "
+        "at 2x real-time load — docs/SERVING.md 'Streaming')",
     )
     parser.add_argument(
         "--batch-size", type=int, default=4,
@@ -1499,6 +1644,7 @@ def main():
         "serve_http": "http_images_per_sec",
         "serve_chaos": "chaos_images_per_sec",
         "tiers": "fast_tier_images_per_sec",
+        "stream": "video_stream_fps",
     }.get(args.config, "uieb_train_images_per_sec_per_chip")
 
     def _fail(error: str, rc: int = 0):
@@ -1593,6 +1739,10 @@ def main():
 
     if args.config == "tiers":
         print(json.dumps(bench_tiers()))
+        return
+
+    if args.config == "stream":
+        print(json.dumps(bench_stream()))
         return
 
     # Two lines (see module docstring): the strict apples-to-apples host-fed

@@ -43,6 +43,7 @@ TPU-native differences:
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -563,8 +564,9 @@ def run_video(
         raise RuntimeError(f"could not open any mp4 encoder for {outpath}")
 
     n = 0
+    ingest: dict = {}
     stream = enhance_video_stream(
-        engine, cap, batch_size=batch_size,
+        engine, cap, batch_size=batch_size, stats=ingest,
         prefetch=2 if workers > 0 else 0,
     )
     for bgr_in, bgr_out in stream:
@@ -575,6 +577,24 @@ def run_video(
             print(f"Processed {n} frames")
     cap.release()
     writer.release()
+    # Ingest accounting (collected by data/video.py since the decode
+    # disambiguation landed, surfaced here): EOF truncation vs mid-stream
+    # decode failure are different failure modes, and a damaged clip must
+    # be visible in the run output, not only as a warning.
+    decoded = int(ingest.get("frames_decoded", 0))
+    failures = int(ingest.get("decode_failures", 0))
+    print(json.dumps({
+        "video_ingest": {
+            "frames_decoded": decoded,
+            "decode_failures_mid_stream": failures,
+            "frames_skipped": failures,
+            "frames_written": n,
+            "declared_frame_count": total,
+            # Declared-but-never-reached frames (container metadata vs
+            # actual stream end); negative declarations clamp to 0.
+            "missing_at_eof": max(0, total - decoded - failures),
+        }
+    }))
 
 
 def main(argv=None):

@@ -1,0 +1,117 @@
+"""Temporal flicker metric (waternet_tpu/metrics/flicker.py): warp
+semantics on synthetic pan sequences with known flows, the identity-flow
+baseline, validity masking, and the index's orderings (a flickering
+enhancement must score worse than a stable one)."""
+
+import numpy as np
+import pytest
+
+from waternet_tpu.metrics.flicker import (
+    flicker_index,
+    identity_flow,
+    warp,
+    warped_error,
+)
+
+
+def _pan_frames(rng, n=4, hw=(24, 32), step=(3, 2)):
+    """Sliding crops of one big texture: frame t starts at t*(sy, sx),
+    so the true inter-frame flow is the constant (dx, dy) = (sx, sy)
+    backward flow — integer steps make the warp exact, no interpolation
+    error to tolerate."""
+    h, w = hw
+    sy, sx = step
+    big = np.asarray(
+        rng.integers(0, 256, (h + n * sy, w + n * sx, 3)), dtype=np.uint8
+    )
+    return [
+        big[t * sy:t * sy + h, t * sx:t * sx + w] for t in range(n)
+    ], (sx, sy)
+
+
+def _const_flow(hw, dx, dy):
+    flow = np.zeros((*hw, 2), dtype=np.float32)
+    flow[..., 0] = dx
+    flow[..., 1] = dy
+    return flow
+
+
+def test_warp_exact_on_integer_pan(rng):
+    frames, (sx, sy) = _pan_frames(rng)
+    prev, nxt = frames[0], frames[1]
+    warped, valid = warp(prev, _const_flow(prev.shape[:2], sx, sy))
+    # Valid region: source pixels (x+sx, y+sy) inside prev.
+    h, w = prev.shape[:2]
+    assert valid[: h - sy, : w - sx].all()
+    assert not valid[h - sy:, :].any() and not valid[:, w - sx:].any()
+    np.testing.assert_array_equal(
+        warped[: h - sy, : w - sx],
+        nxt[: h - sy, : w - sx].astype(np.float32),
+    )
+
+
+def test_identity_flow_is_plain_difference(rng):
+    a = np.asarray(rng.integers(0, 256, (8, 9, 3)), dtype=np.uint8)
+    b = np.asarray(rng.integers(0, 256, (8, 9, 3)), dtype=np.uint8)
+    expect = np.abs(
+        a.astype(np.float32) - b.astype(np.float32)
+    ).mean()
+    assert warped_error(a, b) == pytest.approx(expect)
+    assert identity_flow(a, b).shape == (8, 9, 2)
+
+
+def test_pan_sequence_zero_with_true_flow_nonzero_without(rng):
+    frames, (sx, sy) = _pan_frames(rng)
+
+    def true_flow(prev, nxt):
+        return _const_flow(prev.shape[:2], sx, sy)
+
+    # Motion-compensated: a pan of an unchanging texture does not
+    # flicker. Uncompensated (identity flow): the pan itself reads as
+    # frame-to-frame error, strictly larger.
+    assert flicker_index(frames, flow_fn=true_flow) == pytest.approx(0.0)
+    assert flicker_index(frames) > 1.0
+
+
+def test_flicker_orders_stable_vs_flickering(rng):
+    frames, (sx, sy) = _pan_frames(rng, n=5)
+
+    def true_flow(prev, nxt):
+        return _const_flow(prev.shape[:2], sx, sy)
+
+    # A "flickering enhancement": alternate frames get a global
+    # brightness swing — exactly the temporal artifact the metric pins.
+    flicker = [
+        np.clip(
+            f.astype(np.float32) + (25.0 if i % 2 else -25.0), 0, 255
+        ).astype(np.uint8)
+        for i, f in enumerate(frames)
+    ]
+    stable = flicker_index(frames, flow_fn=true_flow)
+    swingy = flicker_index(flicker, flow_fn=true_flow)
+    assert swingy > stable + 10.0
+
+
+def test_subpixel_flow_interpolates():
+    # A horizontal ramp shifted by half a pixel: bilinear sampling must
+    # land exactly between neighbors on the interior.
+    ramp = np.tile(
+        np.arange(0, 64, 4, dtype=np.float32), (6, 1)
+    )
+    warped, valid = warp(ramp, _const_flow(ramp.shape[:2], 0.5, 0.0))
+    inner = warped[:, :-1][valid[:, :-1]]
+    expect = (ramp[:, :-1] + 2.0)[valid[:, :-1]]
+    np.testing.assert_allclose(inner, expect, atol=1e-5)
+
+
+def test_degenerate_inputs():
+    a = np.zeros((4, 4, 3), np.uint8)
+    assert flicker_index([]) == 0.0
+    assert flicker_index([a]) == 0.0
+    with pytest.raises(ValueError, match="shape"):
+        warped_error(a, np.zeros((5, 4, 3), np.uint8))
+    with pytest.raises(ValueError, match="flow shape"):
+        warp(a, np.zeros((4, 4, 3), np.float32))
+    # All-invalid flow (everything maps off-frame): defined, not NaN.
+    off = _const_flow((4, 4), 100.0, 100.0)
+    assert warped_error(a, a, off) == 0.0

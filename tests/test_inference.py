@@ -71,6 +71,7 @@ def test_engine_data_sharded_matches_single_device(random_params, sample_rgb):
     )
 
 
+@pytest.mark.slow  # ~85 s: sharded×int8 compose; each axis has its own fast tier-1 parity test
 def test_engine_data_sharded_quantized(random_params, sample_rgb):
     """data_shards composes with the int8 path."""
     from waternet_tpu.inference_engine import InferenceEngine

@@ -134,6 +134,7 @@ def test_healthz_enhance_stats_smoke(server, engine, rng):
         "warmed": True,
         "draining": False,
         "status": "ok",
+        "active_streams": 0,
         "replicas": {"quality": {"0": "healthy"}},
     }
 

@@ -399,8 +399,18 @@ def test_stats_schema_and_latency_percentiles():
         "nan_outputs", "quarantines", "reintegrations",
         "recovery_sec_max", "replica_health", "queue_depth",
         "queue_depth_mean", "queue_depth_max", "replicas",
-        "images_per_sec", "load_imbalance", "tiers", "per_replica",
+        "images_per_sec", "load_imbalance", "tiers", "streams",
+        "per_replica",
     }
+    # Stream counters (docs/SERVING.md "Streaming"): present with zeros
+    # on a server that never opened a session, live gauges default-safe.
+    assert set(summary["streams"]) == {
+        "opened", "refused", "frames_in", "frames_delivered",
+        "frames_dropped", "frames_out_of_budget", "downgrades",
+        "active_streams", "per_session_p99_ms", "frame_latency_ms",
+    }
+    assert summary["streams"]["active_streams"] == 0
+    assert summary["streams"]["per_session_p99_ms"] == {}
     # Fault-isolation counters (docs/SERVING.md "Fault isolation").
     assert summary["retried"] == 2
     assert summary["downgraded"] == 1
@@ -939,7 +949,8 @@ def test_bench_serving_multi_scales_on_multicore():
      ("serve_multi", "mixed_res_dir_images_per_sec_multidev"),
      ("serve_http", "http_images_per_sec"),
      ("serve_chaos", "chaos_images_per_sec"),
-     ("tiers", "fast_tier_images_per_sec")],
+     ("tiers", "fast_tier_images_per_sec"),
+     ("stream", "video_stream_fps")],
 )
 def test_bench_serve_fail_line_keeps_own_metric(config, metric):
     """Unreachable hardware in the serve configs: rc 0 and the

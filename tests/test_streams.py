@@ -1,0 +1,611 @@
+"""Live-stream sessions (waternet_tpu/serving/streams.py, docs/SERVING.md
+"Streaming"): the ISSUE 11 acceptance pins — in-order delivery with
+bit-identity to offline under crash/hang re-dispatch, the bounded-latency
+budget drop (un-computed, explicit D record), drop-oldest under a stalled
+consumer, stall isolation (a wedged client provably never delays a
+healthy concurrent stream), the three degradation rungs (per-frame
+brown-out downgrade / frame dropping / admission refusal with 503 +
+Retry-After), disconnect cleanup, per-frame decode quarantine, the
+stream gauges on /stats + /healthz, zero jit-cache growth across stream
+traffic, and the loadgen --stream per-frame accounting.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from waternet_tpu.resilience import faults
+from waternet_tpu.serving import BucketLadder, SupervisionConfig
+from waternet_tpu.serving.loadgen import run_stream_load
+from waternet_tpu.serving.server import ServingServer
+from waternet_tpu.serving.streams import (
+    FLAG_DOWNGRADED,
+    FRAME_LEN,
+    KIND_DROP,
+    KIND_END,
+    KIND_ERROR,
+    KIND_FRAME,
+    REC_HEAD,
+)
+from waternet_tpu.utils.tensor import ten2arr
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.distill_fixture import FIXTURE_DIR  # noqa: E402
+
+#: Same single executable shape as the rest of the serving suite: after
+#: the first compile the persistent XLA cache makes every server warmup
+#: in this module a deserialize (tier-1 budget discipline).
+BUCKET = (32, 32)
+MAX_BATCH = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.models import WaterNet
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    return InferenceEngine(params=params)
+
+
+@pytest.fixture(scope="module")
+def student_params():
+    from waternet_tpu.hub import resolve_weights
+
+    return resolve_weights(str(FIXTURE_DIR / "student.npz"))
+
+
+@pytest.fixture
+def server(engine):
+    """A running front door with default stream knobs. Function-scoped on
+    purpose: the conftest thread-leak guard proves full shutdown (incl.
+    stream sessions released) after every single test, and the drain in
+    teardown only succeeds once active_streams is back to zero."""
+    srv = ServingServer(
+        engine,
+        BucketLadder([BUCKET]),
+        max_batch=MAX_BATCH,
+        max_wait_ms=30,
+        replicas=1,
+        max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    yield srv
+    srv.request_drain()
+    assert srv.join() == 0
+
+
+def _sup(**kw):
+    """Supervision with test-speed scan/re-warm (recovery in ms)."""
+    kw.setdefault("scan_interval_sec", 0.005)
+    kw.setdefault("rewarm_backoff_sec", 0.01)
+    return SupervisionConfig(**kw)
+
+
+def _wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _png(rgb):
+    import cv2
+
+    ok, buf = cv2.imencode(".png", rgb[:, :, ::-1])
+    assert ok
+    return buf.tobytes()
+
+
+def _response_rgb(body):
+    import cv2
+
+    bgr = cv2.imdecode(np.frombuffer(body, np.uint8), cv2.IMREAD_COLOR)
+    assert bgr is not None
+    return cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+
+
+def _expected_offline(engine, rgb):
+    """The offline enhance_padded output a delivered frame must match
+    byte-for-byte: same bucket, same slot count, same crop."""
+    h, w = rgb.shape[:2]
+    out = ten2arr(
+        engine.enhance_padded_async([rgb], BUCKET, n_slots=MAX_BATCH)
+    )
+    return out[0, :h, :w]
+
+
+def _get_json(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60.0)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+# -- raw stream client (protocol-level assertions loadgen abstracts away) --
+
+
+def _open_stream(port, headers=None, timeout=60.0):
+    """POST /stream and parse the response head; returns the live socket,
+    a buffered reader over it, the status, and the response headers."""
+    sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    lines = [
+        "POST /stream HTTP/1.1",
+        f"Host: 127.0.0.1:{port}",
+    ] + [f"{k}: {v}" for k, v in (headers or {}).items()]
+    sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode("latin-1"))
+    f = sock.makefile("rb")
+    status = int(f.readline().split()[1])
+    hdrs = {}
+    while True:
+        line = f.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            break
+        k, _, v = line.decode("latin-1").partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    return sock, f, status, hdrs
+
+
+def _send_frame(sock, payload):
+    sock.sendall(FRAME_LEN.pack(len(payload)) + payload)
+
+
+def _send_end(sock):
+    sock.sendall(FRAME_LEN.pack(0))
+
+
+def _read_records(f):
+    """All records up to and including the Z summary (or EOF)."""
+    recs = []
+    while True:
+        head = f.read(REC_HEAD.size)
+        if len(head) < REC_HEAD.size:
+            break
+        kind, flags, seq, n = REC_HEAD.unpack(head)
+        payload = f.read(n) if n else b""
+        recs.append((kind, flags, seq, payload))
+        if kind == KIND_END:
+            break
+    return recs
+
+
+def _summary_record(recs):
+    assert recs and recs[-1][0] == KIND_END, recs
+    return json.loads(recs[-1][3])
+
+
+# ---------------------------------------------------------------------------
+# Tentpole pin: in-order, bit-identical to offline, under re-dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_stream_in_order_bit_identity_under_redispatch(
+    engine, rng, compile_sentinel
+):
+    """replica_crash@K + replica_hang@K on a 2-replica pool under one
+    stream: PR-9 re-dispatch may complete batches out of order and
+    retry them on the surviving replica, but the session still delivers
+    every frame, strictly in submit order, each byte-identical to the
+    offline enhance_padded result — and the whole episode (stream
+    traffic, crash retry, watchdog re-dispatch, re-warm) compiles
+    nothing beyond the two warmups."""
+    srv = ServingServer(
+        engine,
+        BucketLadder([BUCKET]),
+        max_batch=MAX_BATCH,
+        max_wait_ms=30,
+        replicas=2,
+        max_queue=64,
+        supervision=_sup(watchdog_sec=1.0),
+    )
+    srv.start_background()
+    srv.wait_ready()
+    frames = [
+        np.asarray(rng.integers(0, 256, (h, w, 3)), dtype=np.uint8)
+        for h, w in [(30, 30), (32, 32), (28, 31), (31, 26), (29, 32)]
+    ]
+    # Offline references BEFORE arming: their first call may build the
+    # offline padded executable; the sentinel must only see the stream.
+    expected = [_expected_offline(engine, rgb) for rgb in frames]
+    compile_sentinel.arm(forward=engine._forward)
+    try:
+        faults.install(faults.FaultPlan.parse("replica_crash@1,replica_hang@2"))
+        try:
+            sock, f, status, hdrs = _open_stream(
+                srv.bound_port,
+                {"X-Stream-Fps": "30", "X-Stream-Budget-Ms": "60000",
+                 "X-Stream-Window": "16"},
+            )
+            assert status == 200
+            assert hdrs["content-type"] == "application/x-waternet-stream"
+            for rgb in frames:
+                _send_frame(sock, _png(rgb))
+            _send_end(sock)
+            recs = _read_records(f)
+            sock.close()
+        finally:
+            faults.clear()  # releases the hang latch for the retired thread
+        assert [r[0] for r in recs[:-1]] == [KIND_FRAME] * len(frames)
+        assert [r[2] for r in recs[:-1]] == list(range(len(frames)))
+        for (_, flags, _, body), ref in zip(recs[:-1], expected):
+            assert flags == 0
+            np.testing.assert_array_equal(_response_rgb(body), ref)
+        z = _summary_record(recs)
+        assert z["frames_in"] == len(frames)
+        assert z["delivered"] == len(frames)
+        assert (z["dropped"], z["out_of_budget"], z["errors"]) == (0, 0, 0)
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+    summary = srv.stats.summary()
+    compile_sentinel.check()  # zero jit growth across stream + re-dispatch
+    assert summary["compiles"] == 2  # 1 bucket x 2 replicas, warmup only
+    assert summary["fallback_native_shapes"] == 0
+    assert summary["retried"] >= 1  # the faults really fired
+    assert summary["streams"]["frames_delivered"] == len(frames)
+
+
+# ---------------------------------------------------------------------------
+# Bounded latency: rung 2 of the ladder (budget drops, drop-oldest)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_budget_expiry_drops_uncomputed(server, rng):
+    """A 1 ms freshness budget with spaced frames (each one meets the
+    dispatcher alone, so none can ride a batch-mate's flush): every
+    frame's deadline is gone by dispatch, so the batcher drops it
+    UN-COMPUTED (zero batches launched) and the session answers an
+    explicit D record with reason "budget" in sequence position — never
+    a silent gap."""
+    rgb = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    sock, f, status, _ = _open_stream(
+        server.bound_port, {"X-Stream-Budget-Ms": "1"}
+    )
+    assert status == 200
+    for _ in range(3):
+        _send_frame(sock, _png(rgb))
+        time.sleep(0.05)  # let the expired frame resolve before the next
+    _send_end(sock)
+    recs = _read_records(f)
+    sock.close()
+    assert [r[0] for r in recs[:-1]] == [KIND_DROP] * 3
+    assert [r[2] for r in recs[:-1]] == [0, 1, 2]
+    assert all(
+        json.loads(r[3])["reason"] == "budget" for r in recs[:-1]
+    )
+    z = _summary_record(recs)
+    assert z["out_of_budget"] == 3 and z["delivered"] == 0
+    _, stats = _get_json(server.bound_port, "/stats")
+    st = stats["streams"]
+    assert st["frames_in"] == 3
+    assert st["frames_out_of_budget"] == 3
+    assert st["frames_delivered"] == 0
+    assert stats["batches"] == 0  # dropped deliberately, never computed
+
+
+def test_stream_window_drop_oldest_under_stalled_consumer(server, rng):
+    """stream_stall@1 wedges the session's own delivery; with window=1
+    the drop-oldest policy sheds the backlog: every frame still gets
+    exactly one record, in order (drop notices ride the sequence, never
+    mid-reorder), the newest work survives, and nothing times out."""
+    rgb = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    n = 5
+    os.environ["WATERNET_FAULT_STALL_SEC"] = "0.2"
+    faults.install(faults.FaultPlan.parse("stream_stall@1"))
+    try:
+        sock, f, status, _ = _open_stream(
+            server.bound_port,
+            {"X-Stream-Window": "1", "X-Stream-Budget-Ms": "60000"},
+        )
+        assert status == 200
+        for _ in range(n):
+            _send_frame(sock, _png(rgb))
+        _send_end(sock)
+        recs = _read_records(f)
+        sock.close()
+    finally:
+        faults.clear()
+        os.environ.pop("WATERNET_FAULT_STALL_SEC", None)
+    kinds = [r[0] for r in recs[:-1]]
+    assert [r[2] for r in recs[:-1]] == list(range(n))  # one record each
+    assert set(kinds) <= {KIND_FRAME, KIND_DROP}
+    assert kinds.count(KIND_DROP) >= 3  # the stall really shed work
+    assert kinds.count(KIND_FRAME) >= 1  # newest work survives
+    for kind, _, _, body in recs[:-1]:
+        if kind == KIND_DROP:
+            assert json.loads(body)["reason"] == "window"
+    z = _summary_record(recs)
+    assert z["delivered"] + z["dropped"] == n
+    assert (z["out_of_budget"], z["errors"]) == (0, 0)
+    _, stats = _get_json(server.bound_port, "/stats")
+    assert stats["streams"]["frames_dropped"] >= 3
+
+
+def test_stalled_stream_never_delays_healthy_stream(server, rng):
+    """The stall-isolation acceptance pin: a wedged consumer (every one
+    of its deliveries stalls 0.3 s) backpressures ONLY its own session.
+    A healthy stream running concurrently keeps real-time latency — its
+    p99 stays far under the stalled session's multi-second delivery
+    tail, which a shared/serialized delivery path could not do."""
+    rgb = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    payload = _png(rgb)
+    os.environ["WATERNET_FAULT_STALL_SEC"] = "0.3"
+    faults.install(faults.FaultPlan.parse("stream_stall@1"))
+    try:
+        # Session 1: the stalled victim (we do not read until the end).
+        sock, f, status, _ = _open_stream(
+            server.bound_port,
+            {"X-Stream-Window": "2", "X-Stream-Budget-Ms": "60000"},
+        )
+        assert status == 200
+        for _ in range(6):
+            _send_frame(sock, payload)
+        # Session 2: healthy, paced, concurrent with the stalled one.
+        report = run_stream_load(
+            server.url, [payload], streams=1, frames=6, fps=50.0,
+            budget_ms=5000.0, window=8,
+        )
+        _send_end(sock)
+        recs = _read_records(f)  # ~0.3 s per record: the stall is real
+        sock.close()
+    finally:
+        faults.clear()
+        os.environ.pop("WATERNET_FAULT_STALL_SEC", None)
+    assert report["ok"] == 6, report
+    assert report["conn_reset"] == 0 and report["errors"] == 0
+    # Healthy p99 bounded well under the stalled session's >= 1.8 s
+    # delivery tail: the stall did not leak across sessions.
+    assert report["frame_latency_ms"]["p99"] < 1000.0, report
+    # The stalled session itself still accounted every frame.
+    z = _summary_record(recs)
+    assert z["delivered"] + z["dropped"] == 6
+    assert z["errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Rung 1: per-frame brown-out downgrade (opt-in only)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_brownout_downgrades_frames_inline(
+    engine, student_params, rng
+):
+    """slow_replica@1 holds the first quality batch in flight, so the
+    quality backlog sits at the (lowered) brown-out watermark when the
+    next frames arrive: the opted-in stream's later frames are served
+    by the fast CAN tier, flagged FLAG_DOWNGRADED on the wire, counted
+    in /stats — and delivery order still holds (the un-downgraded head
+    frame lands first)."""
+    from waternet_tpu.inference_engine import StudentEngine
+
+    srv = ServingServer(
+        engine,
+        BucketLadder([BUCKET]),
+        max_batch=MAX_BATCH,
+        max_wait_ms=10,
+        replicas=1,
+        max_queue=64,
+        fast_engine=StudentEngine(params=student_params),
+        downgrade_watermark=1,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    rgb = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    payload = _png(rgb)
+    os.environ["WATERNET_FAULT_SLOW_SEC"] = "0.6"
+    faults.install(faults.FaultPlan.parse("slow_replica@1"))
+    try:
+        sock, f, status, _ = _open_stream(
+            srv.bound_port,
+            {"X-Tier": "quality", "X-Tier-Allow-Downgrade": "1",
+             "X-Stream-Budget-Ms": "60000"},
+        )
+        assert status == 200
+        _send_frame(sock, payload)
+        time.sleep(0.25)  # frame 0's quality batch is launched (and held)
+        for _ in range(3):
+            _send_frame(sock, payload)
+        _send_end(sock)
+        recs = _read_records(f)
+        sock.close()
+    finally:
+        faults.clear()
+        os.environ.pop("WATERNET_FAULT_SLOW_SEC", None)
+        srv.request_drain()
+        assert srv.join() == 0
+    assert [r[0] for r in recs[:-1]] == [KIND_FRAME] * 4
+    assert [r[2] for r in recs[:-1]] == [0, 1, 2, 3]
+    flags = [r[1] for r in recs[:-1]]
+    assert flags[0] == 0  # the held quality frame is NOT downgraded
+    assert all(fl & FLAG_DOWNGRADED for fl in flags[1:]), flags
+    z = _summary_record(recs)
+    assert z["delivered"] == 4 and z["downgraded"] == 3
+    assert srv.stats.summary()["streams"]["downgrades"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Rung 3: admission refusal protects established streams
+# ---------------------------------------------------------------------------
+
+
+def test_stream_admission_refusal_spares_established_stream(engine, rng):
+    """max_streams=1: the second session is refused up front (503 +
+    Retry-After, counted), while the established stream keeps working —
+    frames sent after the refusal still deliver. /healthz carries the
+    live active_streams gauge while the session is open."""
+    srv = ServingServer(
+        engine,
+        BucketLadder([BUCKET]),
+        max_batch=MAX_BATCH,
+        max_wait_ms=30,
+        replicas=1,
+        max_queue=64,
+        max_streams=1,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    rgb = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    try:
+        sock, f, status, _ = _open_stream(
+            srv.bound_port, {"X-Stream-Budget-Ms": "60000"}
+        )
+        assert status == 200
+        _send_frame(sock, _png(rgb))
+        _wait_for(
+            lambda: _get_json(srv.bound_port, "/healthz")[1][
+                "active_streams"
+            ] == 1,
+            what="active_streams gauge",
+        )
+        s2, f2, status2, hdrs2 = _open_stream(srv.bound_port, {})
+        assert status2 == 503
+        assert "retry-after" in hdrs2
+        f2.read()  # drain the refusal body; server closes the connection
+        s2.close()
+        _, stats = _get_json(srv.bound_port, "/stats")
+        assert stats["streams"]["refused"] == 1
+        assert stats["streams"]["active_streams"] == 1
+        # The established stream is untouched by the refusal.
+        _send_frame(sock, _png(rgb))
+        _send_end(sock)
+        recs = _read_records(f)
+        sock.close()
+        assert [r[0] for r in recs[:-1]] == [KIND_FRAME, KIND_FRAME]
+        assert _summary_record(recs)["delivered"] == 2
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+# ---------------------------------------------------------------------------
+# Disconnect cleanup + per-frame decode quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_stream_disconnect_cancels_only_its_frames(server, rng):
+    """stream_disconnect@1 kills the first session after 2 frames: the
+    loadgen client accounts the unanswered frames as conn_reset (not
+    silence, not hard errors), the server books the session's queued
+    frames as disconnect drops, and the NEXT session on the same server
+    is untouched."""
+    payload = _png(
+        np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    )
+    faults.install(faults.FaultPlan.parse("stream_disconnect@1"))
+    try:
+        report = run_stream_load(
+            server.url, [payload], streams=1, frames=5, fps=100.0,
+            budget_ms=5000.0,
+        )
+    finally:
+        faults.clear()
+    assert report["conn_reset"] >= 1, report
+    assert report["errors"] == 0 and report["refused"] == 0
+    # Every sent frame lands in exactly one bucket.
+    assert (
+        report["ok"] + report["dropped"] + report["out_of_budget"]
+        + report["frame_errors"] + report["conn_reset"]
+        == report["frames_sent"]
+    ), report
+    _wait_for(
+        lambda: _get_json(server.bound_port, "/healthz")[1][
+            "active_streams"
+        ] == 0,
+        what="session cleanup",
+    )
+    _, stats = _get_json(server.bound_port, "/stats")
+    assert stats["streams"]["frames_dropped"] >= 1  # disconnect drops
+    # The server is unharmed: a fresh session delivers everything.
+    report2 = run_stream_load(
+        server.url, [payload], streams=1, frames=3, fps=50.0,
+        budget_ms=10000.0,
+    )
+    assert report2["ok"] == 3, report2
+    assert report2["conn_reset"] == 0 and report2["errors"] == 0
+
+
+def test_frame_corrupt_quarantines_only_its_frame(server, rng):
+    """frame_corrupt@2 (and a genuinely undecodable payload): each bad
+    frame becomes an E record in its own sequence position; the frames
+    around it deliver and the stream survives to its clean end."""
+    rgb = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    good = _png(rgb)
+    faults.install(faults.FaultPlan.parse("frame_corrupt@2"))
+    try:
+        sock, f, status, _ = _open_stream(
+            server.bound_port, {"X-Stream-Budget-Ms": "60000"}
+        )
+        assert status == 200
+        for payload in (good, good, good, b"definitely not an image"):
+            _send_frame(sock, payload)
+        _send_end(sock)
+        recs = _read_records(f)
+        sock.close()
+    finally:
+        faults.clear()
+    assert [r[0] for r in recs[:-1]] == [
+        KIND_FRAME, KIND_ERROR, KIND_FRAME, KIND_ERROR
+    ]
+    assert [r[2] for r in recs[:-1]] == [0, 1, 2, 3]
+    for _, _, _, body in (recs[1], recs[3]):
+        assert "decodable" in json.loads(body)["error"]
+    z = _summary_record(recs)
+    assert z["delivered"] == 2 and z["errors"] == 2
+    assert z["dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Bench contract line (full run: slow; the fail-line schema is tier-1 in
+# test_serving.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_stream_contract_line():
+    """The video_stream_fps line end-to-end at CPU smoke sizes: schema,
+    client/server per-frame cross-accounting, and the QoS knobs visible
+    at 2x offered load."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    line = bench.bench_stream(
+        n_images=4, max_batch=2, max_buckets=1, base_hw=24,
+        streams=2, frames=3,
+    )
+    assert line["metric"] == "video_stream_fps"
+    assert line["unit"] == "fps/stream"
+    assert line["value"] > 0
+    assert line["accounted"] is True
+    assert line["budget_ms"] > 0
+    assert isinstance(line["p99_within_budget"], bool)
+    assert 0.0 <= line["drop_rate_at_2x"] <= 1.0
+    assert 0.0 <= line["downgrade_rate_at_2x"] <= 1.0
+    assert line["frames_delivered"] > 0
+    assert {"calibrated_fps", "offered_fps_per_stream", "p99_frame_ms",
+            "fps_per_stream_at_2x"} <= set(line)

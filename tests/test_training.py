@@ -156,6 +156,7 @@ def test_spatially_sharded_train_step_matches_dp():
         np.testing.assert_allclose(m_dp[k], m_sp[k], rtol=2e-4, err_msg=k)
 
 
+@pytest.mark.slow  # ~45 s: the fast representative is the non-perceptual dp×sp parity above
 def test_spatially_sharded_train_step_matches_dp_with_perceptual():
     """Same dp×sp == dp invariant with the VGG perceptual term ON.
 
@@ -398,6 +399,7 @@ def test_device_cached_matches_host_fed_under_spatial_sharding():
         )
 
 
+@pytest.mark.slow  # ~2 min: the histeq precache parity above pins the same hoist fast
 def test_precache_vgg_ref_matches_in_step():
     """precache_vgg_ref=True (the perceptual ref branch's VGG forward
     hoisted to cache-build time, gathered per step by [variant, item])
@@ -487,6 +489,7 @@ def test_precache_vgg_ref_matches_in_step():
         bad2.cache_dataset(ds, idx)
 
 
+@pytest.mark.slow  # ~90 s: eval precache with the VGG table; transform-table parity stays tier-1
 def test_eval_cached_precache_matches_in_step():
     """The eval-side precache (identity-variant transform tables, and with
     precache_vgg_ref the feature table too) must score identically to the

@@ -1,0 +1,14 @@
+"""Quality metrics that are not training losses.
+
+Currently: temporal flicker (:mod:`waternet_tpu.metrics.flicker`) — the
+warped frame-to-frame error that pins enhanced video streams against
+visible flicker (ROADMAP item 4's quality side).
+"""
+
+from waternet_tpu.metrics.flicker import (
+    flicker_index,
+    identity_flow,
+    warp,
+)
+
+__all__ = ["flicker_index", "identity_flow", "warp"]

@@ -1,0 +1,125 @@
+"""Temporal flicker: warped frame-to-frame error for enhanced video.
+
+Per-frame enhancement can be photometrically unstable — two nearly
+identical input frames map to visibly different outputs, which a viewer
+perceives as flicker even when every single frame looks fine. The
+standard pin (the temporal-consistency term in video style transfer and
+the benchmark practice in optical-flow work such as *Disentangling
+Architecture and Training for Optical Flow*, arXiv:2203.10712) is the
+**warped** frame difference: motion-compensate the previous frame with
+the inter-frame flow, then measure what changed beyond the motion.
+
+``flicker_index(frames)`` is the mean over consecutive pairs of the
+masked mean absolute error between ``warp(prev, flow)`` and ``next`` —
+0 for a video whose enhancement commutes with motion, larger the more
+the enhancement "swims". The flow is pluggable (``flow_fn(prev, next)
+-> (H, W, 2)`` dx/dy in pixels); the default is the identity flow
+(pure frame difference), which is exact for static cameras and an
+upper bound otherwise — callers with a flow estimator pass it in, and
+the synthetic-pan unit tests pin the warp semantics with known flows.
+
+Numpy only: this runs over decoded uint8/float frames on the host (a
+bench column, not a training loss — the differentiable use is ROADMAP
+item 4's remaining half).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def identity_flow(prev: np.ndarray, nxt: np.ndarray) -> np.ndarray:
+    """The zero flow: ``warp`` becomes the identity and the flicker
+    index degenerates to the plain frame difference (exact for a static
+    camera, an upper bound under motion)."""
+    h, w = prev.shape[:2]
+    return np.zeros((h, w, 2), dtype=np.float32)
+
+
+def warp(frame: np.ndarray, flow: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Backward-warp ``frame`` by ``flow``; returns ``(warped, valid)``.
+
+    ``flow[y, x] = (dx, dy)`` means the content at ``(x, y)`` in the
+    NEXT frame came from ``(x + dx, y + dy)`` in ``frame`` (backward
+    mapping — every output pixel gets a value, no splatting holes).
+    Bilinear sampling; ``valid`` is False where the source location
+    falls outside the frame, and those pixels are excluded from the
+    error, not compared against garbage. ``warped`` is float32 in the
+    input's value range; any (H, W) or (H, W, C) frame works.
+    """
+    frame = np.asarray(frame)
+    flow = np.asarray(flow, dtype=np.float32)
+    h, w = frame.shape[:2]
+    if flow.shape[:2] != (h, w) or flow.shape[-1] != 2:
+        raise ValueError(
+            f"flow shape {flow.shape} does not match frame {frame.shape}"
+        )
+    gy, gx = np.mgrid[0:h, 0:w].astype(np.float32)
+    sx = gx + flow[..., 0]
+    sy = gy + flow[..., 1]
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    # Clamp for sampling; invalid pixels are masked out of the metric.
+    sx = np.clip(sx, 0, w - 1)
+    sy = np.clip(sy, 0, h - 1)
+    x0 = np.floor(sx).astype(np.int64)
+    y0 = np.floor(sy).astype(np.int64)
+    x1 = np.minimum(x0 + 1, w - 1)
+    y1 = np.minimum(y0 + 1, h - 1)
+    fx = (sx - x0).astype(np.float32)
+    fy = (sy - y0).astype(np.float32)
+    if frame.ndim == 3:
+        fx = fx[..., None]
+        fy = fy[..., None]
+    f = frame.astype(np.float32)
+    top = f[y0, x0] * (1.0 - fx) + f[y0, x1] * fx
+    bot = f[y1, x0] * (1.0 - fx) + f[y1, x1] * fx
+    warped = top * (1.0 - fy) + bot * fy
+    return warped, valid
+
+
+def warped_error(
+    prev: np.ndarray,
+    nxt: np.ndarray,
+    flow: Optional[np.ndarray] = None,
+) -> float:
+    """Masked mean absolute error between ``warp(prev, flow)`` and
+    ``nxt`` — the per-pair flicker term. ``flow=None`` uses the
+    identity flow. 0.0 when no pixel is valid (degenerate flow)."""
+    prev = np.asarray(prev)
+    nxt = np.asarray(nxt)
+    if prev.shape != nxt.shape:
+        raise ValueError(
+            f"frame shapes differ: {prev.shape} vs {nxt.shape}"
+        )
+    if flow is None:
+        flow = identity_flow(prev, nxt)
+    warped, valid = warp(prev, flow)
+    if not valid.any():
+        return 0.0
+    diff = np.abs(warped - nxt.astype(np.float32))
+    if diff.ndim == 3:
+        diff = diff.mean(axis=-1)
+    return float(diff[valid].mean())
+
+
+def flicker_index(
+    frames: Sequence[np.ndarray],
+    flow_fn: Optional[Callable] = None,
+) -> float:
+    """Mean warped frame-to-frame error over consecutive pairs.
+
+    ``flow_fn(prev, nxt) -> (H, W, 2)`` supplies the inter-frame flow
+    per pair (default: :func:`identity_flow`). Returns 0.0 for fewer
+    than two frames — a single frame cannot flicker."""
+    frames = list(frames)
+    if len(frames) < 2:
+        return 0.0
+    if flow_fn is None:
+        flow_fn = identity_flow
+    errs = [
+        warped_error(prev, nxt, flow_fn(prev, nxt))
+        for prev, nxt in zip(frames[:-1], frames[1:])
+    ]
+    return float(np.mean(errs))

@@ -46,6 +46,23 @@ is a set of one-shot events, each keyed by a deterministic counter:
   depth, exercising the shed path and client retry behavior without
   having to actually saturate the queue
   (serving/server.py calls :func:`admit_should_reject`).
+* ``stream_stall@K`` — the K-th stream session opened on the front door
+  (1-based, process-global) behaves as a wedged consumer: every record
+  delivery to that session sleeps ``WATERNET_FAULT_STALL_SEC`` (default
+  0.25) before the write, the faithful signature of a client that
+  stopped reading — the deterministic way to prove a stalled stream
+  backpressures only itself (serving/streams.py calls
+  :func:`stream_session_fault` at session open).
+* ``stream_disconnect@K`` — the K-th stream session opened is
+  force-disconnected server-side after reading
+  ``WATERNET_FAULT_DISCONNECT_FRAMES`` (default 2) frames, simulating a
+  client that vanished mid-stream with frames still queued — the
+  cancellation/cleanup path without real socket timing races.
+* ``frame_corrupt@K`` — the K-th stream frame decode attempt (1-based,
+  process-global across sessions, under a lock) is treated as
+  undecodable, exercising the per-frame quarantine path: that frame
+  alone errors, its session and every other stream keep flowing
+  (serving/streams.py calls :func:`frame_should_corrupt`).
 
 Plans come from the environment (``WATERNET_FAULTS="nan@3,sigterm@10"``,
 read once by :func:`install_from_env`, which train.py calls) or from tests
@@ -73,6 +90,8 @@ _IMREAD_LOCK = threading.Lock()
 _LAUNCH_CALLS = 0
 _ADMIT_CALLS = 0
 _COMPLETE_CALLS = 0
+_STREAM_SESSIONS = 0
+_FRAME_DECODES = 0
 _SERVE_LOCK = threading.Lock()
 #: Release latch for armed ``replica_hang`` events: a wedged launch thread
 #: waits on this, and :func:`install` / :func:`clear` set it — so a test
@@ -87,7 +106,8 @@ class FaultPlan:
     KINDS = (
         "nan", "sigterm", "truncate_ckpt", "decode",
         "slow_replica", "replica_crash", "replica_hang", "nan_output",
-        "reject_admit",
+        "reject_admit", "stream_stall", "stream_disconnect",
+        "frame_corrupt",
     )
 
     def __init__(self, events=()):
@@ -127,7 +147,8 @@ class FaultPlan:
 
 def install(plan: FaultPlan | None) -> None:
     global _PLAN, _IMREAD_CALLS, _LAUNCH_CALLS, _ADMIT_CALLS
-    global _COMPLETE_CALLS, _HANG_RELEASE
+    global _COMPLETE_CALLS, _STREAM_SESSIONS, _FRAME_DECODES
+    global _HANG_RELEASE
     with _SERVE_LOCK:
         # Release any launch thread wedged by the PREVIOUS plan's
         # replica_hang before swapping latches: hangs are releasable by
@@ -142,6 +163,8 @@ def install(plan: FaultPlan | None) -> None:
         _LAUNCH_CALLS = 0
         _ADMIT_CALLS = 0
         _COMPLETE_CALLS = 0
+        _STREAM_SESSIONS = 0
+        _FRAME_DECODES = 0
     with _IMREAD_LOCK:
         _IMREAD_CALLS = 0
 
@@ -306,6 +329,67 @@ def admit_should_reject() -> bool:
     with _SERVE_LOCK:
         _ADMIT_CALLS += 1
         return _PLAN.fire("reject_admit", _ADMIT_CALLS)
+
+
+class StreamSessionFault(NamedTuple):
+    """What the K-th opened stream session should suffer. ``stall`` means
+    the session behaves as a wedged consumer (every delivery sleeps
+    ``WATERNET_FAULT_STALL_SEC`` before the write); ``disconnect_after``
+    is None, or the frame count after which the session's reader must
+    simulate a peer reset (kind ``stream_disconnect``)."""
+
+    stall: bool
+    disconnect_after: "int | None"
+
+
+_NO_STREAM_FAULT = StreamSessionFault(False, None)
+
+
+def stream_session_fault() -> StreamSessionFault:
+    """Hook run once per stream session open in
+    :class:`waternet_tpu.serving.streams.StreamManager`.
+
+    Keyed by a process-global session-open counter under a lock (kinds
+    ``stream_stall`` and ``stream_disconnect`` share the ordinal: the
+    K-th session opened). With no plan installed this is a single ``is
+    None`` check.
+    """
+    global _STREAM_SESSIONS
+    if _PLAN is None:
+        return _NO_STREAM_FAULT
+    with _SERVE_LOCK:
+        _STREAM_SESSIONS += 1
+        k = _STREAM_SESSIONS
+        stall = _PLAN.fire("stream_stall", k)
+        disconnect = _PLAN.fire("stream_disconnect", k)
+    after = (
+        int(os.environ.get("WATERNET_FAULT_DISCONNECT_FRAMES", "2"))
+        if disconnect
+        else None
+    )
+    return StreamSessionFault(stall, after)
+
+
+def stream_stall_sec() -> float:
+    """How long a stalled stream session sleeps before each delivery."""
+    return float(os.environ.get("WATERNET_FAULT_STALL_SEC", "0.25"))
+
+
+def frame_should_corrupt() -> bool:
+    """Hook run before each stream frame decode attempt
+    (waternet_tpu/serving/streams.py).
+
+    Returns True when this frame must be treated as undecodable (kind
+    ``frame_corrupt``, keyed by a process-global frame-decode counter
+    across every stream session, under a lock). With no plan installed
+    this is a single ``is None`` check.
+    """
+    global _FRAME_DECODES
+    if _PLAN is None:
+        return False
+    with _SERVE_LOCK:
+        _FRAME_DECODES += 1
+        return _PLAN.fire("frame_corrupt", _FRAME_DECODES)
 
 
 def after_checkpoint_save(path, ordinal: int) -> None:

@@ -11,6 +11,7 @@ from waternet_tpu.serving.batcher import (
     DynamicBatcher,
     ExactShapeBatcher,
     QueueFull,
+    RequestCancelled,
     UnknownTier,
     fit_ladder_to_engine,
     resolve_ladder,
@@ -43,6 +44,7 @@ __all__ = [
     "QueueFull",
     "ReplicaPool",
     "ReplicaUnavailable",
+    "RequestCancelled",
     "ServingStats",
     "SupervisionConfig",
     "UnknownTier",

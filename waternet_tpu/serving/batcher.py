@@ -71,6 +71,16 @@ class UnknownTier(ValueError):
     a tier is a quality contract, not a routing hint."""
 
 
+class RequestCancelled(RuntimeError):
+    """A request's caller walked away before compute — a stream session
+    disconnected or drop-oldest evicted the frame. The owner marks the
+    request's future with ``abandoned = True`` (never ``Future.cancel()``,
+    which would race the replica completion thread's ``set_result``);
+    the dispatcher and the re-dispatch path honor the mark by setting
+    this exception instead of computing, so batch-mates from other
+    sessions are untouched."""
+
+
 class DeadlineExpired(RuntimeError):
     """A request's deadline ran out before its batch was computed. Raised
     from submit() when the deadline is already past at admission, and set
@@ -585,7 +595,18 @@ class DynamicBatcher:
         now = time.perf_counter()
         live: List[_Request] = []
         for r in reqs:
-            if r.deadline is not None and r.deadline <= now:
+            if getattr(r.future, "abandoned", False):
+                # Caller walked away (stream disconnect / drop-oldest):
+                # the dispatcher solely owns un-dispatched pending
+                # requests, so resolving here cannot race a replica.
+                if not r.future.done():
+                    r.future.set_exception(
+                        RequestCancelled(
+                            "request abandoned by its caller; "
+                            "dropped un-computed at dispatch"
+                        )
+                    )
+            elif r.deadline is not None and r.deadline <= now:
                 self.stats.record_deadline_expired()
                 if not r.future.done():
                     r.future.set_exception(

@@ -23,6 +23,11 @@ docs/SERVING.md "Fault isolation") — drive opt-in traffic with
 ``allow_downgrade=True`` / ``--allow-downgrade``. Stdlib-only
 (http.client + threads); worker threads carry the pipeline
 ``THREAD_PREFIX`` so the test suite's leak guard covers them.
+
+``--stream`` switches to :func:`run_stream_load`: N paced concurrent
+``POST /stream`` sessions (open-loop — live cameras do not slow down for
+a busy server) with per-frame latency / drop / downgrade accounting and
+the same conn_reset-vs-errors split.
 """
 
 from __future__ import annotations
@@ -30,6 +35,7 @@ from __future__ import annotations
 import argparse
 import itertools
 import json
+import struct
 import sys
 import threading
 import time
@@ -178,6 +184,229 @@ def run_load(
     return report
 
 
+# ----------------------------------------------------------------------
+# Stream mode: N paced concurrent POST /stream sessions
+# ----------------------------------------------------------------------
+
+# Client-side copies of the stream wire framing
+# (waternet_tpu/serving/streams.py — kept import-free here so the load
+# generator stays stdlib-only; the protocol-compat tests drive this
+# client against a live server, so drift cannot go unnoticed).
+_FRAME_LEN = struct.Struct("!I")
+_REC_HEAD = struct.Struct("!cBII")
+_FLAG_DOWNGRADED = 1
+
+
+def _read_exact(f, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes from a socket file, None on EOF."""
+    chunks = []
+    while n:
+        chunk = f.read(n)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def run_stream_load(
+    url: str,
+    payloads: List[bytes],
+    streams: int = 4,
+    frames: int = 16,
+    fps: float = 10.0,
+    budget_ms: Optional[float] = None,
+    window: Optional[int] = None,
+    tier: Optional[str] = None,
+    allow_downgrade: bool = False,
+    timeout: float = 120.0,
+) -> Dict:
+    """Replay ``payloads`` as ``streams`` paced concurrent POST /stream
+    sessions (``frames`` frames each at ``fps``); returns the aggregate
+    per-frame accounting report.
+
+    Open-loop per stream ON PURPOSE — a live camera does not slow down
+    because the server is busy, so frame ``i`` is sent at
+    ``t0 + i/fps`` regardless of what came back. Every sent frame ends
+    in exactly one bucket: ``ok`` (enhanced frame delivered, its
+    end-to-end latency sampled), ``dropped`` (explicit drop record:
+    window overflow / queue shed / disconnect cleanup),
+    ``out_of_budget`` (drop record with reason ``budget``),
+    ``frame_errors`` (per-frame error record), or — when the connection
+    died under the session — ``conn_reset`` / ``errors`` absorb the
+    unaccounted remainder, split exactly as in :func:`run_load` (a
+    graceful close is not a crash). ``refused`` counts sessions the
+    server turned away at admission (503, degradation rung 3);
+    ``downgraded`` counts delivered frames served by the fast tier
+    under brown-out (the record's downgrade flag).
+    """
+    import socket
+
+    u = urlparse(url)
+    host, port = u.hostname, u.port or 80
+    lock = threading.Lock()
+    counts = {
+        "ok": 0, "dropped": 0, "out_of_budget": 0, "frame_errors": 0,
+        "downgraded": 0, "refused": 0, "conn_reset": 0, "errors": 0,
+    }
+    totals = {"frames_sent": 0}
+    latencies: List[float] = []
+
+    def stream_worker(si: int):
+        t_sent: Dict[int, float] = {}
+        accounted = 0  # frames that got a record (or a refusal)
+        sent = 0
+        reset = False
+        sock = None
+        try:
+            sock = socket.create_connection((host, port), timeout=timeout)
+            head = (
+                "POST /stream HTTP/1.1\r\n"
+                f"Host: {host}:{port}\r\n"
+                f"X-Stream-Fps: {fps}\r\n"
+            )
+            if budget_ms is not None:
+                head += f"X-Stream-Budget-Ms: {budget_ms}\r\n"
+            if window is not None:
+                head += f"X-Stream-Window: {window}\r\n"
+            if tier is not None:
+                head += f"X-Tier: {tier}\r\n"
+            if allow_downgrade:
+                head += "X-Tier-Allow-Downgrade: 1\r\n"
+            head += "\r\n"
+            sock.sendall(head.encode("latin-1"))
+            f = sock.makefile("rb")
+            status_line = f.readline()
+            status = int(status_line.split()[1]) if status_line else 0
+            while True:  # skip response headers
+                line = f.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+            if status != 200:
+                with lock:
+                    counts["refused" if status == 503 else "errors"] += 1
+                return
+
+            done = threading.Event()
+
+            def sender():
+                nonlocal sent
+                t0 = time.perf_counter()
+                try:
+                    for i in range(frames):
+                        lag = t0 + i / fps - time.perf_counter()
+                        if lag > 0:
+                            time.sleep(lag)
+                        payload = payloads[i % len(payloads)]
+                        with lock:
+                            t_sent[i] = time.perf_counter()
+                        sock.sendall(
+                            _FRAME_LEN.pack(len(payload)) + payload
+                        )
+                        sent += 1
+                    sock.sendall(_FRAME_LEN.pack(0))  # clean end
+                except OSError:
+                    pass  # server closed mid-upload; reader accounts
+                finally:
+                    done.set()
+
+            tx = threading.Thread(
+                target=sender,
+                name=f"{THREAD_PREFIX}-stream-tx-{si}",
+                daemon=True,
+            )
+            tx.start()
+            try:
+                while True:
+                    raw = _read_exact(f, _REC_HEAD.size)
+                    if raw is None:
+                        reset = True  # session ended without a Z record
+                        break
+                    kind, flags, seq, n = _REC_HEAD.unpack(raw)
+                    payload = _read_exact(f, n) if n else b""
+                    if n and payload is None:
+                        reset = True
+                        break
+                    t_recv = time.perf_counter()
+                    if kind == b"Z":
+                        break
+                    with lock:
+                        accounted += 1
+                        if kind == b"F":
+                            counts["ok"] += 1
+                            if flags & _FLAG_DOWNGRADED:
+                                counts["downgraded"] += 1
+                            if seq in t_sent:
+                                latencies.append(t_recv - t_sent[seq])
+                        elif kind == b"D":
+                            reason = json.loads(payload).get("reason")
+                            counts[
+                                "out_of_budget"
+                                if reason == "budget"
+                                else "dropped"
+                            ] += 1
+                        else:  # b"E"
+                            counts["frame_errors"] += 1
+            except OSError:
+                reset = True
+            done.wait(timeout)
+        except OSError as err:
+            with lock:
+                counts[
+                    "conn_reset"
+                    if isinstance(
+                        err, (ConnectionResetError, BrokenPipeError)
+                    )
+                    else "errors"
+                ] += 1
+        finally:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            with lock:
+                totals["frames_sent"] += sent
+                # Frames sent but never answered by any record: the
+                # connection died under them. conn_reset, not silence.
+                if reset and sent > accounted:
+                    counts["conn_reset"] += sent - accounted
+
+    threads = [
+        threading.Thread(
+            target=stream_worker,
+            args=(i,),
+            name=f"{THREAD_PREFIX}-stream-{i}",
+            daemon=True,
+        )
+        for i in range(max(1, int(streams)))
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    lat_sorted = sorted(latencies)
+    ok = counts["ok"]
+    return {
+        "streams": int(streams),
+        "frames_per_stream": int(frames),
+        "offered_fps": float(fps),
+        **totals,
+        **counts,
+        "fps_per_stream": (
+            round(ok / max(1, int(streams)) / elapsed, 2) if elapsed else 0.0
+        ),
+        "elapsed_sec": round(elapsed, 3),
+        "frame_latency_ms": {
+            "p50": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
+            "p99": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
+        },
+    }
+
+
 def _synthetic_payloads(spec: str, n: int = 8) -> List[bytes]:
     """``HxW`` -> n deterministic PNG payloads (no dataset needed)."""
     import cv2
@@ -223,6 +452,36 @@ def main(argv=None) -> int:
         "serve quality requests from the fast tier instead of shedding "
         "— the report's 'downgraded' counts how often it did.",
     )
+    parser.add_argument(
+        "--stream", action="store_true", default=False,
+        help="Stream mode: replay the payloads as N paced concurrent "
+        "POST /stream sessions (open-loop, like live cameras) with "
+        "per-frame latency/drop/downgrade accounting instead of "
+        "closed-loop /enhance requests.",
+    )
+    parser.add_argument(
+        "--streams", type=int, default=4,
+        help="Concurrent stream sessions (--stream mode).",
+    )
+    parser.add_argument(
+        "--frames", type=int, default=16,
+        help="Frames per stream (--stream mode).",
+    )
+    parser.add_argument(
+        "--fps", type=float, default=10.0,
+        help="Paced frame rate per stream, declared to the server as "
+        "X-Stream-Fps (--stream mode).",
+    )
+    parser.add_argument(
+        "--budget-ms", type=float, default=None,
+        help="Per-frame freshness budget (X-Stream-Budget-Ms); default: "
+        "the server derives 3000/fps (--stream mode).",
+    )
+    parser.add_argument(
+        "--window", type=int, default=None,
+        help="Per-stream delivery window (X-Stream-Window); default: "
+        "the server's --stream-window (--stream mode).",
+    )
     args = parser.parse_args(argv)
 
     if args.source:
@@ -238,6 +497,20 @@ def main(argv=None) -> int:
             return 2
     else:
         payloads = _synthetic_payloads(args.synthetic)
+    if args.stream:
+        report = run_stream_load(
+            args.url,
+            payloads,
+            streams=args.streams,
+            frames=args.frames,
+            fps=args.fps,
+            budget_ms=args.budget_ms,
+            window=args.window,
+            tier=args.tier,
+            allow_downgrade=args.allow_downgrade,
+        )
+        print(json.dumps(report))
+        return 0
     report = run_load(
         args.url,
         payloads,

@@ -753,6 +753,20 @@ class ReplicaPool:
         for r in reqs:
             if r.future.done():
                 continue
+            if getattr(r.future, "abandoned", False):
+                # The caller walked away (stream disconnect /
+                # drop-oldest) while the batch was failing; the claim
+                # protocol hands this path sole ownership, so resolving
+                # here cannot race the completion thread.
+                from waternet_tpu.serving.batcher import RequestCancelled
+
+                r.future.set_exception(
+                    RequestCancelled(
+                        "request abandoned by its caller; dropped "
+                        "instead of retried"
+                    )
+                )
+                continue
             deadline = getattr(r, "deadline", None)
             if deadline is not None and deadline <= now:
                 from waternet_tpu.serving.batcher import DeadlineExpired

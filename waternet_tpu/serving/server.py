@@ -52,11 +52,21 @@ for the traffic patterns a library never sees:
   downgrade watermark; ``X-Tier-Served`` on the response names the tier
   that actually served.
 
+* **Stream sessions.** ``POST /stream`` opens a live video session
+  (waternet_tpu/serving/streams.py, docs/SERVING.md "Streaming"):
+  length-prefixed JPEG/PNG frames in, enhanced frames out in strict
+  submit order on the same connection, each frame under a freshness
+  budget derived from the stream's declared fps, with explicit
+  drop-oldest / brown-out / refuse-new-sessions degradation under
+  overload. Stream admission is bounded by ``--max-streams``; the
+  per-session delivery window by ``--stream-window``.
+
 Endpoints: ``POST /enhance`` (image file bytes in, PNG out — the body
 is whatever ``cv2.imdecode`` reads, which is exactly what ``cv2.imread``
 reads on the local path, so the CLI and the service stay behaviorally
-interchangeable via ``inference.py --serve-url``); ``GET /healthz``;
-``GET /stats``; ``POST /admin/reload``.
+interchangeable via ``inference.py --serve-url``); ``POST /stream``
+(length-prefixed frame session); ``GET /healthz``; ``GET /stats``;
+``POST /admin/reload``.
 
 The HTTP layer is deliberately hand-rolled on ``asyncio.start_server``
 (persistent connections, Content-Length bodies): the container bakes no
@@ -93,6 +103,7 @@ from waternet_tpu.serving.replicas import (
     SupervisionConfig,
 )
 from waternet_tpu.serving.stats import ServingStats
+from waternet_tpu.serving.streams import StreamConfig, StreamManager
 
 _REASONS = {
     200: "OK",
@@ -191,6 +202,8 @@ class ServingServer:
         fast_engine=None,
         supervision: Optional[SupervisionConfig] = None,
         downgrade_watermark: Optional[int] = None,
+        max_streams: int = 8,
+        stream_window: int = 8,
     ):
         if admit_watermark is None:
             # Shed before QueueFull would fire: the watermark is the soft
@@ -215,8 +228,11 @@ class ServingServer:
         self.min_deadline_ms = float(min_deadline_ms)
         self.supervision = supervision
         self.downgrade_watermark = int(downgrade_watermark)
+        self.max_streams = int(max_streams)
+        self.stream_window = int(stream_window)
         self.stats = stats if stats is not None else ServingStats()
         self.batcher: Optional[DynamicBatcher] = None
+        self.streams: Optional[StreamManager] = None
         self.bound_port: Optional[int] = None
         self.ready = threading.Event()
         self.draining = threading.Event()
@@ -320,6 +336,16 @@ class ServingServer:
 
             loop = asyncio.get_running_loop()
             self.batcher = await loop.run_in_executor(None, _build_batcher)
+            self.streams = StreamManager(
+                batcher=self.batcher,
+                stats=self.stats,
+                max_streams=self.max_streams,
+                window=self.stream_window,
+                admit_watermark=self.admit_watermark,
+                decode=_decode_request_image,
+                encode=_encode_response_png,
+                draining=self.draining,
+            )
             self.ready.set()
             print(
                 f"waternet-serve: ready ({len(self.ladder)} buckets x "
@@ -347,7 +373,14 @@ class ServingServer:
             while time.monotonic() < deadline:
                 with self._inflight_lock:
                     inflight = self._inflight
-                if inflight == 0 and self.batcher.queue_depth() == 0:
+                if (
+                    inflight == 0
+                    and self.batcher.queue_depth() == 0
+                    and (
+                        self.streams is None
+                        or self.streams.active_count() == 0
+                    )
+                ):
                     clean = True
                     break
                 await asyncio.sleep(0.02)
@@ -375,7 +408,7 @@ class ServingServer:
                 req = await self._read_request(reader)
                 if req is None:
                     break
-                keep = await self._dispatch(req, writer)
+                keep = await self._dispatch(req, reader, writer)
                 await writer.drain()
                 if not keep:
                     break
@@ -454,13 +487,25 @@ class ServingServer:
 
     # -- routing -------------------------------------------------------
 
-    async def _dispatch(self, req, writer) -> bool:
+    async def _dispatch(self, req, reader, writer) -> bool:
         method, path, headers, body = req
         want_close = headers.get("connection", "").lower() == "close"
         if _content_length(headers) > MAX_BODY_BYTES:
             return self._json(
                 writer, 413, {"error": "payload too large"}, close=True
             )
+        if path == "/stream":
+            if method != "POST":
+                return self._json(
+                    writer,
+                    405,
+                    {"error": "POST a length-prefixed frame stream "
+                     "to /stream"},
+                )
+            # A stream session owns the rest of the connection (the
+            # upload has no Content-Length); it always closes.
+            await self._stream(headers, reader, writer)
+            return False
         if path == "/healthz":
             return self._healthz(writer) and not want_close
         if path == "/stats":
@@ -494,6 +539,14 @@ class ServingServer:
             "ready": ready,
             "warmed": self.ready.is_set(),
             "draining": self.draining.is_set(),
+            # Streams open right now: an honest readiness signal keeps
+            # reporting ready while sessions are live (they're traffic,
+            # not a fault), but orchestrators can see the load.
+            "active_streams": (
+                self.streams.active_count()
+                if self.streams is not None
+                else 0
+            ),
         }
         if not self.ready.is_set():
             payload["status"] = "warming"
@@ -701,6 +754,92 @@ class ServingServer:
             with self._inflight_lock:
                 self._inflight -= 1
 
+    # -- /stream -------------------------------------------------------
+
+    async def _stream(self, headers, reader, writer) -> None:
+        """One stream session end to end (docs/SERVING.md "Streaming").
+
+        Admission mirrors ``/enhance`` — draining and warming answer
+        503; tier names are validated loudly — plus the stream-specific
+        third degradation rung: past ``--max-streams`` open sessions or
+        a saturated queue, NEW sessions get 503 + Retry-After while
+        established sessions keep their QoS. Admitted sessions get the
+        ``application/x-waternet-stream`` response head and then run
+        entirely inside the :class:`StreamManager`."""
+        if self.draining.is_set():
+            self._json(writer, 503, {"error": "draining"}, close=True)
+            return
+        if not self.ready.is_set():
+            self._json(
+                writer,
+                503,
+                {"error": "warming up"},
+                extra=(("Retry-After", "1"),),
+                close=True,
+            )
+            return
+        try:
+            cfg = StreamConfig.from_headers(headers, self.stream_window)
+        except ValueError as err:
+            self._json(writer, 400, {"error": str(err)}, close=True)
+            return
+        if cfg.tier not in ("quality", "fast"):
+            self._json(
+                writer,
+                400,
+                {
+                    "error": f"unknown tier {cfg.tier!r}",
+                    "tiers": list(self.batcher.tiers),
+                },
+                close=True,
+            )
+            return
+        if cfg.tier not in self.batcher.tiers:
+            self._json(
+                writer,
+                400,
+                {
+                    "error": "fast tier not configured on this server "
+                    "(start waternet-serve with --student-weights)",
+                    "tiers": list(self.batcher.tiers),
+                },
+                close=True,
+            )
+            return
+        refusal = self.streams.refusal()
+        if refusal is not None:
+            # Degradation rung 3: refuse NEW sessions, protect the
+            # established ones. 503 (not 429): the service is telling
+            # orchestrators to place the stream elsewhere for a while.
+            self.stats.record_stream_refused()
+            self._json(
+                writer,
+                503,
+                {"error": refusal},
+                extra=(("Retry-After", "1"),),
+                close=True,
+            )
+            return
+        # In-flight for the drain poll, like /enhance: the batcher must
+        # not close under an admitted session.
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            head = (
+                "HTTP/1.1 200 OK\r\n"
+                "Content-Type: application/x-waternet-stream\r\n"
+                "Connection: close\r\n"
+                "\r\n"
+            )
+            writer.write(head.encode("latin-1"))
+            await writer.drain()
+            await self.streams.handle(cfg, reader, writer)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # client went away; the session already cleaned up
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
     # -- /admin/reload -------------------------------------------------
 
     def _do_reload(self, path: str):
@@ -869,6 +1008,19 @@ def parse_args(argv=None):
         "never applied to requests that didn't opt in.",
     )
     parser.add_argument(
+        "--max-streams", type=int, default=8,
+        help="Open stream-session bound: past it NEW POST /stream "
+        "sessions are refused with 503 + Retry-After while established "
+        "streams keep their QoS (docs/SERVING.md 'Streaming').",
+    )
+    parser.add_argument(
+        "--stream-window", type=int, default=8,
+        help="Default per-stream delivery window: frames awaiting "
+        "delivery past it are dropped oldest-first with an explicit "
+        "drop record (clients override per session with "
+        "X-Stream-Window).",
+    )
+    parser.add_argument(
         "--precision", type=str, default="fp32", choices=["fp32", "bf16"],
     )
     return parser.parse_args(argv)
@@ -937,6 +1089,8 @@ def main(argv=None) -> int:
             max_retries=args.serve_max_retries,
         ),
         downgrade_watermark=args.downgrade_watermark,
+        max_streams=args.max_streams,
+        stream_window=args.stream_window,
     )
     return server.run(install_signal_handlers=True)
 

@@ -75,7 +75,18 @@ class ServingStats:
       ``recovery_sec_max`` the longest quarantine→reintegration span;
       ``replica_health`` — the LIVE per-tier ``{replica: state}`` map,
       read through the probe the owning batcher registers (empty for
-      stats objects nothing registered on).
+      stats objects nothing registered on);
+    * **stream counters** (``streams``, docs/SERVING.md "Streaming"):
+      session opens/refusals and per-frame accounting for the
+      POST ``/stream`` session layer — ``frames_dropped`` (window
+      overflow, queue shed, disconnect cleanup), ``frames_out_of_budget``
+      (freshness deadline ran out), ``downgrades`` (stream frames served
+      by the fast tier under brown-out), a frame end-to-end latency
+      reservoir (read -> record written), plus the LIVE
+      ``active_streams`` gauge and per-session p99 map read through the
+      probe the owning
+      :class:`~waternet_tpu.serving.streams.StreamManager` registers
+      (0 / {} for stats objects nothing registered on).
     """
 
     def __init__(self):
@@ -119,6 +130,21 @@ class ServingStats:
         self._tiers = {}
         self._t_first_batch = None
         self._t_last_done = None
+        # --- stream-session counters (POST /stream layer) ---
+        self.streams_opened = 0
+        self.streams_refused = 0
+        self.stream_frames_in = 0
+        self.stream_frames_delivered = 0
+        self.stream_frames_dropped = 0
+        self.stream_frames_out_of_budget = 0
+        self.stream_downgrades = 0
+        self._stream_lat_s: List[float] = []  # bounded reservoir sample
+        self._stream_rng = random.Random(1)
+        #: Live stream gauge: a zero-arg callable the owning StreamManager
+        #: registers, returning {"active_streams": int,
+        #: "per_session_p99_ms": {stream_id: p99}}. Left None, the summary
+        #: reports 0 / {} — most stats objects have no stream layer.
+        self.stream_probe = None
 
     def declare_tier(self, tier: str) -> None:
         """Register a serving tier up front (a ReplicaPool does this at
@@ -241,6 +267,62 @@ class ServingStats:
             self.reintegrations += 1
             self._recovery_max_s = max(self._recovery_max_s, recovery_sec)
 
+    def record_stream_open(self) -> None:
+        """One stream session admitted on POST /stream."""
+        with self._lock:
+            self.streams_opened += 1
+
+    def record_stream_refused(self) -> None:
+        """One stream session refused at admission (503 + Retry-After:
+        the third rung of the degradation ladder, or a draining server)."""
+        with self._lock:
+            self.streams_refused += 1
+
+    def record_stream_frame_in(self) -> None:
+        """One frame read off a stream session's upload."""
+        with self._lock:
+            self.stream_frames_in += 1
+
+    def record_stream_frame_delivered(self, seconds: float) -> None:
+        """One enhanced frame written back to its stream client;
+        ``seconds`` is the end-to-end frame span (read -> record
+        written), sampled into a bounded reservoir like request
+        latency."""
+        with self._lock:
+            self.stream_frames_delivered += 1
+            if len(self._stream_lat_s) < LATENCY_RESERVOIR:
+                self._stream_lat_s.append(seconds)
+            else:
+                j = self._stream_rng.randrange(self.stream_frames_delivered)
+                if j < LATENCY_RESERVOIR:
+                    self._stream_lat_s[j] = seconds
+
+    def record_stream_drop(self, reason: str) -> None:
+        """One stream frame deliberately not delivered. ``reason``
+        ``"budget"`` (freshness deadline ran out) counts as
+        out-of-budget; any other reason (``"window"`` overflow,
+        ``"queue"`` shed, ``"disconnect"`` cleanup, ``"cancelled"``)
+        counts as a drop."""
+        with self._lock:
+            if reason == "budget":
+                self.stream_frames_out_of_budget += 1
+            else:
+                self.stream_frames_dropped += 1
+
+    def record_stream_downgrade(self) -> None:
+        """One stream frame served by the fast tier under brown-out
+        pressure (the first rung of the degradation ladder)."""
+        with self._lock:
+            self.stream_downgrades += 1
+
+    def stream_latency_ms(self) -> Dict[str, float]:
+        with self._lock:
+            vals = sorted(self._stream_lat_s)
+        return {
+            "p50": round(_percentile(vals, 0.50) * 1e3, 3),
+            "p99": round(_percentile(vals, 0.99) * 1e3, 3),
+        }
+
     def record_fallback(self) -> None:
         with self._lock:
             self.fallback_native += 1
@@ -343,6 +425,24 @@ class ServingStats:
             reintegrations = self.reintegrations
             recovery_max = self._recovery_max_s
             tiers = {name: dict(c) for name, c in self._tiers.items()}
+            stream_probe = self.stream_probe
+            streams = {
+                "opened": self.streams_opened,
+                "refused": self.streams_refused,
+                "frames_in": self.stream_frames_in,
+                "frames_delivered": self.stream_frames_delivered,
+                "frames_dropped": self.stream_frames_dropped,
+                "frames_out_of_budget": self.stream_frames_out_of_budget,
+                "downgrades": self.stream_downgrades,
+            }
+        live = (
+            stream_probe()
+            if stream_probe is not None
+            else {"active_streams": 0, "per_session_p99_ms": {}}
+        )
+        streams["active_streams"] = live["active_streams"]
+        streams["per_session_p99_ms"] = live["per_session_p99_ms"]
+        streams["frame_latency_ms"] = self.stream_latency_ms()
         return {
             "requests": requests,
             "batches": batches,
@@ -369,6 +469,7 @@ class ServingStats:
             "images_per_sec": round(self.images_per_sec(), 2),
             "load_imbalance": round(self.load_imbalance(), 3),
             "tiers": tiers,
+            "streams": streams,
             "per_replica": self.per_replica(),
         }
 
