@@ -741,6 +741,137 @@ def bench_serving_chaos(
     }
 
 
+def bench_train_chaos(
+    workers=2, epochs=3, n_images=8, batch=4, hw=32,
+    kill_at=None, hang_at=None, max_restarts=4, hang_sec=12.0,
+    job_dir=None,
+):
+    """Elastic-training chaos bench (docs/RESILIENCE.md "Multi-process
+    supervision"): a supervised ``workers``-process gloo training job with
+    one worker KILLED hard (``proc_kill``, generation 0) and one worker
+    HUNG without heartbeating (``proc_hang``, generation 1) mid-run. The
+    contract line reports sustained throughput THROUGH the faults
+    (``chaos_train_images_per_sec`` — the job's logical images over the
+    chaos job's wall clock, restarts included), the restart count,
+    ``recovery_sec`` (failure detection -> first heartbeat of the next
+    generation), ``steps_lost`` (work discarded by resuming from the last
+    complete checkpoint, heartbeat-resolution), and ``exact_resume`` —
+    whether the relaunched job's metric CSVs and final weights came out
+    byte-identical to an uninterrupted control run (the PR-1 replay
+    guarantee, exercised across process generations).
+
+    Workers are tiny synthetic CPU-gloo train.py runs (1 forced host
+    device each, serialized dispatch — the multi-process CPU transport
+    constraint): the machinery under test is the supervisor, not the
+    chips, so the line is hardware-independent; the parent still owns the
+    relay fail-line for unreachable-tunnel environments.
+    """
+    import shutil
+    import subprocess  # noqa: F401  (workers spawn under the supervisor)
+    import sys
+    import tempfile
+    from pathlib import Path
+
+    from waternet_tpu.resilience.supervisor import Supervisor, SupervisorConfig
+
+    kill_at = _env_int("WATERNET_BENCH_CHAOS_KILL_AT", 3) if kill_at is None else kill_at
+    hang_at = kill_at + 2 if hang_at is None else hang_at
+    owned = job_dir is None
+    job = Path(tempfile.mkdtemp(prefix="waternet-train-chaos-") if owned else job_dir)
+    repo = Path(__file__).resolve().parent
+
+    def _run(tag, faults):
+        root = job / tag / "training"
+        argv = [
+            sys.executable, str(repo / "train.py"),
+            "--synthetic", str(n_images), "--batch-size", str(batch),
+            "--height", str(hw), "--width", str(hw),
+            "--no-perceptual", "--precision", "fp32",
+            "--epochs", str(epochs), "--checkpoint-every", "2",
+            "--workers", "0", "--train-root", str(root),
+        ]
+        cfg = SupervisorConfig(
+            num_workers=workers, max_restarts=max_restarts,
+            backoff_base_sec=0.1, backoff_cap_sec=0.5,
+            late_sec=max(1.0, hang_sec / 3), hang_sec=hang_sec,
+            startup_grace_sec=600.0, drain_grace_sec=10.0,
+            poll_sec=0.05, heartbeat_sec=0.0, cpu_gloo=True,
+        )
+        sup = Supervisor(argv, job / tag / "supervise", cfg, faults=faults)
+        t0 = time.perf_counter()
+        report = sup.run()
+        return report, time.perf_counter() - t0, root
+
+    def _final_run_dir(root):
+        done = sorted(
+            (d for d in root.iterdir() if (d / "metrics-train.csv").is_file()),
+            key=lambda d: int(d.name),
+        ) if root.is_dir() else []
+        return done[-1] if done else None
+
+    try:
+        ctl_report, ctl_s, ctl_root = _run("control", {})
+        chaos_report, chaos_s, chaos_root = _run(
+            "chaos",
+            {(0, 1): f"proc_kill@{kill_at}", (1, 0): f"proc_hang@{hang_at}"},
+        )
+        ctl_dir, chaos_dir = _final_run_dir(ctl_root), _final_run_dir(chaos_root)
+        exact = False
+        if ctl_dir is not None and chaos_dir is not None:
+            exact = all(
+                (ctl_dir / f).read_bytes() == (chaos_dir / f).read_bytes()
+                for f in ("metrics-train.csv", "metrics-val.csv", "last.npz")
+            )
+        # Steps retrained because a generation resumed from the last
+        # complete checkpoint: span between a failed generation's furthest
+        # observed step and where its successor actually resumed
+        # (heartbeat-resolution — beats are per step here, heartbeat_sec=0).
+        gens = chaos_report["generations"]
+
+        def _last(g):
+            return max((w["last_step"] or 0 for w in g["workers"]), default=0)
+
+        def _first(g):
+            vals = [w["first_step"] for w in g["workers"] if w["first_step"]]
+            return min(vals) if vals else None
+
+        steps_lost = 0
+        for prev, nxt in zip(gens, gens[1:]):
+            if _first(nxt) is not None:
+                steps_lost += max(0, _last(prev) - _first(nxt) + 1)
+        recovery = chaos_report["recovery_sec"]
+        # The job's logical work (what an uninterrupted run trains), over
+        # the chaos wall clock: restarts, backoff, and retraining all tax
+        # the number — exactly what the line is for.
+        n_val = max(1, min(90, n_images // 8))
+        logical_images = epochs * (n_images - n_val)
+        return {
+            "metric": "chaos_train_images_per_sec",
+            "value": round(logical_images / chaos_s, 3) if chaos_s else 0.0,
+            "unit": "images/sec",
+            "vs_baseline": None,
+            "workers": workers,
+            "faults": f"proc_kill@{kill_at}(gen0,rank1),"
+                      f"proc_hang@{hang_at}(gen1,rank0)",
+            "result": chaos_report["result"],
+            "restarts": chaos_report["restarts"],
+            "generations": len(gens),
+            "recovery_sec": round(max(recovery), 2) if recovery else None,
+            "steps_lost": steps_lost,
+            "exact_resume": bool(exact),
+            "control_sec": round(ctl_s, 1),
+            "chaos_sec": round(chaos_s, 1),
+            "control_restarts": ctl_report["restarts"],
+            "epochs": epochs,
+            "n_images": n_images,
+            "batch": batch,
+            "hw": [hw, hw],
+        }
+    finally:
+        if owned:
+            shutil.rmtree(job, ignore_errors=True)
+
+
 def bench_stream(
     n_images=None, max_batch=None, max_buckets=None, base_hw=None,
     streams=None, frames=None,
@@ -1609,7 +1740,7 @@ def main():
     parser.add_argument(
         "--config",
         choices=["train", "video", "serve", "serve_multi", "serve_http",
-                 "serve_chaos", "tiers", "stream"],
+                 "serve_chaos", "train_chaos", "tiers", "stream"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
@@ -1621,6 +1752,10 @@ def main():
         "serve_chaos (closed-loop throughput with one replica crashed "
         "and one hung mid-run: recovery time, retry/downgrade/shed "
         "accounting — docs/SERVING.md 'Fault isolation'), "
+        "train_chaos (a supervised multi-process training job with one "
+        "worker killed and one hung mid-run: restart count, recovery "
+        "time, steps lost, and byte-exactness vs an uninterrupted "
+        "control — docs/RESILIENCE.md 'Multi-process supervision'), "
         "tiers (quality vs fast CAN-student A/B under per-request "
         "tier routing: throughput, FLOP ratio, SSIM-vs-teacher, int8 "
         "arm — docs/SERVING.md 'Quality tiers'), "
@@ -1643,6 +1778,7 @@ def main():
         "serve_multi": "mixed_res_dir_images_per_sec_multidev",
         "serve_http": "http_images_per_sec",
         "serve_chaos": "chaos_images_per_sec",
+        "train_chaos": "chaos_train_images_per_sec",
         "tiers": "fast_tier_images_per_sec",
         "stream": "video_stream_fps",
     }.get(args.config, "uieb_train_images_per_sec_per_chip")
@@ -1735,6 +1871,10 @@ def main():
 
     if args.config == "serve_chaos":
         print(json.dumps(bench_serving_chaos()))
+        return
+
+    if args.config == "train_chaos":
+        print(json.dumps(bench_train_chaos()))
         return
 
     if args.config == "tiers":

@@ -66,3 +66,63 @@ def test_spatial_sharded_inference_engine(sample_rgb):
     a = single.enhance(sample_rgb[None])[0].astype(np.int16)
     b = sharded.enhance(sample_rgb[None])[0].astype(np.int16)
     assert np.abs(a - b).max() <= 1  # uint8 rounding of float-identical outputs
+
+
+# ----------------------------------------------------------------------
+# Restart-context env contract (supervised elastic training)
+# ----------------------------------------------------------------------
+
+from waternet_tpu.parallel import distributed as dist  # noqa: E402
+
+
+def test_restart_context_absent_is_none():
+    assert dist.restart_context(env={}) is None
+
+
+def test_restart_context_full_contract():
+    env = {
+        dist.ENV_COORDINATOR: "10.0.0.1:1234",
+        dist.ENV_NUM_PROCESSES: "4",
+        dist.ENV_PROCESS_ID: "2",
+        dist.ENV_GENERATION: "3",
+    }
+    ctx = dist.restart_context(env=env)
+    assert ctx == dist.RestartContext("10.0.0.1:1234", 4, 2, 3)
+
+
+def test_restart_context_generation_defaults_to_zero():
+    env = {
+        dist.ENV_COORDINATOR: "h:1",
+        dist.ENV_NUM_PROCESSES: "2",
+        dist.ENV_PROCESS_ID: "0",
+    }
+    assert dist.restart_context(env=env).generation == 0
+    assert dist.generation(env={}) == 0
+    assert dist.generation(env={dist.ENV_GENERATION: "5"}) == 5
+
+
+def test_restart_context_partial_contract_is_loud():
+    """A half-stamped contract would silently train N duplicate
+    single-process runs; it must raise naming exactly what is missing."""
+    with pytest.raises(ValueError) as ei:
+        dist.restart_context(env={dist.ENV_COORDINATOR: "h:1"})
+    msg = str(ei.value)
+    assert "WATERNET_NUM_PROCESSES" in msg
+    assert "WATERNET_PROCESS_ID" in msg
+    assert "h:1" in msg  # what IS set is named too
+
+
+def test_initialize_failure_names_coordinator_and_env(monkeypatch):
+    """The explicit-mode re-raise must carry everything an operator needs:
+    the coordinator address, this process's identity, and the env vars
+    consulted — not a bare jax traceback."""
+    monkeypatch.setenv(dist.ENV_CONNECT_TIMEOUT, "1")
+    with pytest.raises(RuntimeError) as ei:
+        initialize(
+            coordinator_address="127.0.0.1:9", num_processes=2, process_id=1
+        )
+    msg = str(ei.value)
+    assert "127.0.0.1:9" in msg
+    assert "process 1/2" in msg
+    assert dist.ENV_COORDINATOR in msg
+    assert dist.ENV_GENERATION in msg

@@ -539,3 +539,92 @@ def test_resume_auto_falls_back_past_truncated_checkpoint(
         meta = auto_resume(eng, work)
     assert meta is not None and meta["step"] == 2
     assert int(jax.device_get(eng.state.step)) == 2
+
+
+# ----------------------------------------------------------------------
+# CheckpointManager: tolerance of a concurrently-restarting peer
+# generation (supervised elastic training scans this directory while a
+# finalizing/pruning sibling may still be touching it)
+# ----------------------------------------------------------------------
+
+
+def _mk_ck(root, step, **meta):
+    from waternet_tpu.resilience.manager import MARKER
+
+    d = root / f"step-{step:010d}"
+    (d / "state").mkdir(parents=True)
+    (d / MARKER).write_text(json.dumps({"step": step, **meta}))
+    return d
+
+
+def test_checkpoint_scan_skips_staging_and_junk(tmp_path):
+    from waternet_tpu.resilience.manager import MARKER, CheckpointManager
+
+    root = tmp_path / "checkpoints"
+    _mk_ck(root, 2)
+    _mk_ck(root, 4)
+    # a concurrently-finalizing peer's staging dirs must never scan as
+    # checkpoints — even one that already carries a marker file
+    staging = root / "step-0000000006.tmp"
+    staging.mkdir()
+    (staging / MARKER).write_text('{"step": 6}')
+    (root / "step-0000000008.orbax-checkpoint-tmp-123").mkdir()
+    (root / ".tmp-step-0000000009").mkdir()
+    (root / "step-junk").mkdir()
+    (root / "step-0000000010").write_text("a plain file, not a step dir")
+    (root / "step-0000000012").mkdir()  # unfinalized: no marker yet
+    assert [ck.step for ck in CheckpointManager(root).checkpoints()] == [2, 4]
+
+
+def test_checkpoint_scan_tolerates_vanish_mid_scan(tmp_path, monkeypatch):
+    """An entry pruned by a peer between the glob and the marker read is
+    skipped, not crashed on."""
+    import pathlib
+
+    from waternet_tpu.resilience.manager import MARKER, CheckpointManager
+
+    root = tmp_path / "checkpoints"
+    _mk_ck(root, 2)
+    victim = _mk_ck(root, 4)
+    _mk_ck(root, 6)
+    real = pathlib.Path.read_text
+
+    def vanishing_read(self, *a, **kw):
+        if self == victim / MARKER:
+            raise FileNotFoundError(str(self))
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(pathlib.Path, "read_text", vanishing_read)
+    assert [ck.step for ck in CheckpointManager(root).checkpoints()] == [2, 6]
+
+
+def test_checkpoint_scan_missing_root_is_empty(tmp_path):
+    from waternet_tpu.resilience.manager import CheckpointManager
+
+    assert CheckpointManager(tmp_path / "never-created").checkpoints() == []
+
+
+def test_restore_latest_good_skips_checkpoint_pruned_by_peer(tmp_path):
+    """A state dir rmtree'd between the scan and the restore attempt is
+    'just gone' (peer retention), not corruption: fall back quietly."""
+    import shutil
+
+    from waternet_tpu.resilience.manager import CheckpointManager
+
+    root = tmp_path / "checkpoints"
+    _mk_ck(root, 2)
+    pruned = _mk_ck(root, 4)
+    shutil.rmtree(pruned / "state")  # marker remains; state vanished
+
+    restored = []
+
+    class _StubEngine:
+        def restore(self, path):
+            restored.append(Path(path))
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        ck = CheckpointManager(root).restore_latest_good(_StubEngine())
+    assert ck is not None and ck.step == 2
+    assert restored == [root / "step-0000000002" / "state"]
+    assert not caught  # quiet skip — no corruption warning for a prune

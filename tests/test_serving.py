@@ -949,6 +949,7 @@ def test_bench_serving_multi_scales_on_multicore():
      ("serve_multi", "mixed_res_dir_images_per_sec_multidev"),
      ("serve_http", "http_images_per_sec"),
      ("serve_chaos", "chaos_images_per_sec"),
+     ("train_chaos", "chaos_train_images_per_sec"),
      ("tiers", "fast_tier_images_per_sec"),
      ("stream", "video_stream_fps")],
 )

@@ -1,0 +1,576 @@
+"""Elastic multi-process training: supervisor + heartbeat tests.
+
+Layering mirrors the production design (docs/RESILIENCE.md "Multi-process
+supervision"):
+
+* the per-worker health state machine is PURE (explicit timestamps), so
+  every transition — late, presumed-hung, startup-grace, terminal exit —
+  is pinned here with no processes and no sleeping;
+* the supervisor's restart orchestration (crash detect -> drain ->
+  backoff -> relaunch with ``--resume auto``; budget exhaustion -> loud
+  report + nonzero exit) is pinned against sub-second stub workers that
+  speak only the env contract — no jax, no training;
+* the end-to-end guarantee — a 2-process gloo job killed mid-epoch
+  restarts automatically and finishes BYTE-identical to an uninterrupted
+  control — is the slow-marked integration test at the bottom.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from waternet_tpu.resilience import faults
+from waternet_tpu.resilience import heartbeat as hb
+from waternet_tpu.resilience.supervisor import (
+    EXIT_BUDGET_EXHAUSTED,
+    Supervisor,
+    SupervisorConfig,
+    _parse_fault_arg,
+    backoff_sec,
+)
+from waternet_tpu.resilience.supervisor import main as supervisor_main
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults(monkeypatch):
+    monkeypatch.delenv("WATERNET_FAULTS", raising=False)
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ----------------------------------------------------------------------
+# WorkerHealth: the pure state machine
+# ----------------------------------------------------------------------
+
+
+def _health(late=10.0, hang=30.0, grace=60.0, t0=1000.0):
+    return hb.WorkerHealth(late, hang, grace, t0)
+
+
+def _beat(t, step=1, phase="train"):
+    return {"time": t, "step": step, "phase": phase}
+
+
+def test_health_freshness_transitions():
+    w = _health()
+    assert w.observe(1005.0) == hb.STARTING
+    w.note_beat(_beat(1010.0, step=5))
+    assert w.observe(1015.0) == hb.RUNNING
+    assert w.observe(1021.0) == hb.LATE  # age 11 >= late_sec 10
+    assert not w.failed  # late is observability only, not actionable
+    assert w.observe(1041.0) == hb.HUNG  # age 31 >= hang_sec 30
+    assert w.failed
+
+
+def test_health_late_recovers_on_fresh_beat():
+    w = _health()
+    w.note_beat(_beat(1010.0))
+    assert w.observe(1025.0) == hb.LATE
+    w.note_beat(_beat(1026.0, step=2))
+    assert w.observe(1027.0) == hb.RUNNING
+
+
+def test_health_exit_codes_are_terminal():
+    done = _health()
+    done.note_beat(_beat(1010.0))
+    assert done.observe(1011.0, exit_code=0) == hb.DONE
+    # terminal: a later observation with an ancient heartbeat stays done
+    assert done.observe(99999.0) == hb.DONE
+    assert not done.failed
+
+    dead = _health()
+    assert dead.observe(1011.0, exit_code=7) == hb.DEAD
+    assert dead.exit_code == 7
+    assert dead.failed
+    assert dead.observe(99999.0, exit_code=0) == hb.DEAD
+
+
+def test_health_startup_grace_hang_before_first_beat():
+    w = _health(grace=60.0)
+    assert w.observe(1059.0) == hb.STARTING
+    assert w.observe(1060.0) == hb.HUNG  # wedged before its first step
+    assert w.failed
+
+
+def test_health_startup_beat_does_not_arm_hang_clock():
+    """Restore + cold compile sit between the startup beat and the first
+    train-step beat; only the startup grace may declare a hang there —
+    hang_sec off the startup beat would drain perfectly healthy workers
+    mid-compile (the false positive a resumed generation hits first)."""
+    w = _health(late=10.0, hang=30.0, grace=100.0)
+    w.note_beat(_beat(1001.0, step=0, phase="startup"))
+    assert w.observe(1050.0) == hb.STARTING  # beat is 49s old: NOT hung
+    assert w.observe(1099.0) == hb.STARTING
+    assert w.observe(1101.0) == hb.HUNG  # grace (from launch) still bounds it
+
+
+def test_health_first_step_ignores_startup_beat():
+    w = _health()
+    w.note_beat(_beat(1001.0, step=0, phase="startup"))
+    assert w.first_step is None  # startup beat is step 0 by construction
+    w.note_beat(_beat(1002.0, step=7, phase="train"))
+    w.note_beat(_beat(1003.0, step=9, phase="train"))
+    assert w.first_step == 7  # where this generation resumed
+    assert w.last_step == 9
+    assert w.summary() == {
+        "state": hb.STARTING,  # observe() not yet called
+        "exit_code": None,
+        "first_step": 7,
+        "last_step": 9,
+    }
+
+
+def test_health_stale_record_does_not_regress():
+    w = _health()
+    w.note_beat(_beat(1010.0, step=5))
+    w.note_beat(_beat(1004.0, step=99))  # older record: ignored wholesale
+    assert w.last_beat == 1010.0
+    assert w.last_step == 5
+
+
+def test_health_rejects_inverted_thresholds():
+    with pytest.raises(ValueError):
+        _health(late=30.0, hang=10.0)
+
+
+def test_backoff_schedule():
+    assert backoff_sec(1.0, 30.0, 1) == 1.0
+    assert backoff_sec(1.0, 30.0, 2) == 2.0
+    assert backoff_sec(1.0, 30.0, 3) == 4.0
+    assert backoff_sec(1.0, 30.0, 10) == 30.0  # capped
+    assert backoff_sec(0.0, 0.0, 5) == 0.0
+
+
+# ----------------------------------------------------------------------
+# HeartbeatWriter / read_heartbeat
+# ----------------------------------------------------------------------
+
+
+def test_heartbeat_writer_throttle_and_force(tmp_path):
+    w = hb.HeartbeatWriter(tmp_path / "worker-000.json", min_interval_sec=60.0)
+    assert w.beat(step=1) is True
+    assert w.beat(step=2) is False  # inside the throttle window
+    assert w.beat(step=3, force=True) is True
+    rec = hb.read_heartbeat(tmp_path / "worker-000.json")
+    assert rec["step"] == 3 and rec["seq"] == 2
+    assert rec["pid"] == os.getpid()
+
+
+def test_heartbeat_resolve_env_contract(tmp_path, monkeypatch):
+    monkeypatch.delenv(hb.ENV_HEARTBEAT_DIR, raising=False)
+    assert hb.HeartbeatWriter.resolve(None) is None  # no flag, no env
+    monkeypatch.setenv(hb.ENV_HEARTBEAT_DIR, str(tmp_path / "env"))
+    monkeypatch.setenv(hb.ENV_HEARTBEAT_SEC, "2.5")
+    w = hb.HeartbeatWriter.resolve(None, process_id=3, generation=2)
+    assert w.path == tmp_path / "env" / "worker-003.json"
+    assert w.min_interval_sec == 2.5
+    # explicit --heartbeat-dir wins over the env contract
+    w2 = hb.HeartbeatWriter.resolve(tmp_path / "flag", process_id=1)
+    assert w2.path == tmp_path / "flag" / "worker-001.json"
+    w2.beat(step=4, phase="val", force=True)
+    rec = hb.read_heartbeat(w2.path)
+    assert rec["phase"] == "val" and rec["process_id"] == 1
+
+
+def test_read_heartbeat_tolerates_missing_and_torn(tmp_path):
+    assert hb.read_heartbeat(tmp_path / "nope.json") is None
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"time": 12')  # truncated mid-swap
+    assert hb.read_heartbeat(torn) is None
+
+
+# ----------------------------------------------------------------------
+# Supervisor orchestration against stub workers (no jax, sub-second)
+# ----------------------------------------------------------------------
+
+# A worker that speaks only the supervisor's env contract. Behavior is
+# driven by STUB_* env vars so one script covers crash / hang / leak
+# scenarios; it records the contract it saw for the launch assertions.
+_STUB = r"""
+import json, os, sys, time
+
+rank = int(os.environ["WATERNET_PROCESS_ID"])
+gen = int(os.environ["WATERNET_GENERATION"])
+hbdir = os.environ["WATERNET_HEARTBEAT_DIR"]
+
+
+def beat(step, phase="train"):
+    path = os.path.join(hbdir, "worker-%03d.json" % rank)
+    with open(path + ".tmp", "w") as f:
+        json.dump({"pid": os.getpid(), "process_id": rank, "generation": gen,
+                   "step": step, "phase": phase, "time": time.time()}, f)
+    os.replace(path + ".tmp", path)
+
+
+contract = {k: v for k, v in os.environ.items() if k.startswith("WATERNET_")}
+contract["argv"] = sys.argv[1:]
+with open(os.path.join(hbdir, "contract-%d.json" % rank), "w") as f:
+    json.dump(contract, f)
+
+beat(1)
+if os.environ.get("STUB_FAULT_CRASH") and os.environ.get("WATERNET_FAULTS"):
+    sys.exit(21)
+if os.environ.get("STUB_CRASH_ALWAYS") and rank == 0:
+    sys.exit(9)
+crash_gen = os.environ.get("STUB_CRASH_GEN")
+if crash_gen is not None and gen == int(crash_gen) \
+        and rank == int(os.environ.get("STUB_CRASH_RANK", "0")):
+    sys.exit(7)
+hang_gen = os.environ.get("STUB_HANG_GEN")
+if hang_gen is not None and gen == int(hang_gen) \
+        and rank == int(os.environ.get("STUB_HANG_RANK", "0")):
+    beat(2)
+    time.sleep(600)  # wedge: alive in the process table, never beats again
+beat(3)
+beat(4, phase="done")
+"""
+
+
+def _stub_supervisor(tmp_path, extra_env=None, faults_map=None, **cfg_kw):
+    cfg = SupervisorConfig(
+        num_workers=2,
+        max_restarts=2,
+        backoff_base_sec=0.0,
+        backoff_cap_sec=0.0,
+        late_sec=0.4,
+        hang_sec=1.2,
+        startup_grace_sec=30.0,
+        drain_grace_sec=5.0,
+        poll_sec=0.02,
+        heartbeat_sec=0.0,
+    )
+    if cfg_kw:
+        cfg = dataclasses.replace(cfg, **cfg_kw)
+    env = dict(os.environ)
+    env.pop("WATERNET_FAULTS", None)
+    env.update(extra_env or {})
+    return Supervisor(
+        [sys.executable, "-c", _STUB, "--alpha", "1"],
+        tmp_path / "sup",
+        cfg,
+        env=env,
+        faults=faults_map,
+    )
+
+
+def _contract(sup, generation, rank):
+    path = sup.heartbeat_dir / f"gen-{generation:03d}" / f"contract-{rank}.json"
+    return json.loads(path.read_text())
+
+
+def test_supervisor_clean_completion_and_env_contract(tmp_path):
+    sup = _stub_supervisor(tmp_path)
+    report = sup.run()
+    assert report["result"] == "completed"
+    assert report["restarts"] == 0
+    assert len(report["generations"]) == 1
+    assert all(w["state"] == hb.DONE for w in report["generations"][0]["workers"])
+    # the launch contract every worker receives
+    for rank in range(2):
+        c = _contract(sup, 0, rank)
+        host, _, port = c["WATERNET_COORDINATOR"].partition(":")
+        assert host == "127.0.0.1" and 0 < int(port) < 65536
+        assert c["WATERNET_NUM_PROCESSES"] == "2"
+        assert c["WATERNET_PROCESS_ID"] == str(rank)
+        assert c["WATERNET_GENERATION"] == "0"
+        assert c["WATERNET_HEARTBEAT_SEC"] == "0.0"
+        assert Path(c["WATERNET_HEARTBEAT_DIR"]) == sup.heartbeat_dir / "gen-000"
+        assert "WATERNET_FAULTS" not in c
+        assert c["argv"] == ["--alpha", "1"]  # no --resume in generation 0
+    assert (sup.heartbeat_dir / "supervisor-report.json").is_file()
+
+
+def test_supervisor_restarts_after_crash_with_resume_auto(tmp_path):
+    sup = _stub_supervisor(
+        tmp_path, extra_env={"STUB_CRASH_GEN": "0", "STUB_CRASH_RANK": "1"}
+    )
+    report = sup.run()
+    assert report["result"] == "completed"
+    assert report["restarts"] == 1
+    assert len(report["generations"]) == 2
+    gen0 = report["generations"][0]
+    assert "worker 1 exited rc=7" in gen0["trigger"]
+    assert gen0["workers"][1]["state"] == hb.DEAD
+    # generation 1 relaunches with --resume auto appended, fresh gen env
+    c = _contract(sup, 1, 0)
+    assert c["argv"] == ["--alpha", "1", "--resume", "auto"]
+    assert c["WATERNET_GENERATION"] == "1"
+    assert c["WATERNET_COORDINATOR"] != _contract(sup, 0, 0)["WATERNET_COORDINATOR"]
+    # the failure-detect -> first-new-generation-beat window was measured
+    assert len(report["recovery_sec"]) == 1
+    assert report["recovery_sec"][0] >= 0.0
+
+
+def test_supervisor_detects_hang_by_heartbeat_timeout(tmp_path):
+    sup = _stub_supervisor(
+        tmp_path, extra_env={"STUB_HANG_GEN": "0", "STUB_HANG_RANK": "0"}
+    )
+    t0 = time.monotonic()
+    report = sup.run()
+    assert report["result"] == "completed"
+    assert report["restarts"] == 1
+    assert "worker 0 presumed hung" in report["generations"][0]["trigger"]
+    # detection came from heartbeat freshness, not from the 600s sleep
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_supervisor_fault_injection_targets_one_worker_one_generation(tmp_path):
+    # STUB_FAULT_CRASH makes any worker that SEES the fault var crash, so
+    # this pins targeting AND the no-leak guarantee in one run: only
+    # (gen 0, rank 1) gets the var, and the relaunch completes cleanly.
+    sup = _stub_supervisor(
+        tmp_path,
+        extra_env={"STUB_FAULT_CRASH": "1"},
+        faults_map={(0, 1): "proc_kill@3"},
+    )
+    report = sup.run()
+    assert report["result"] == "completed"
+    assert report["restarts"] == 1
+    assert _contract(sup, 0, 1)["WATERNET_FAULTS"] == "proc_kill@3"
+    assert "WATERNET_FAULTS" not in _contract(sup, 0, 0)
+    assert "WATERNET_FAULTS" not in _contract(sup, 1, 0)
+    assert "WATERNET_FAULTS" not in _contract(sup, 1, 1)
+
+
+def test_supervisor_budget_exhaustion_is_loud_not_a_hang(tmp_path, capsys):
+    sup = _stub_supervisor(
+        tmp_path, extra_env={"STUB_CRASH_ALWAYS": "1"}, max_restarts=1
+    )
+    report = sup.run()
+    assert report["result"] == "failed"
+    assert report["restarts"] == 1
+    assert len(report["generations"]) == 2  # budget: max_restarts + 1 gens
+    err = capsys.readouterr().err
+    assert "RETRY BUDGET EXHAUSTED" in err
+    assert "generation 0" in err and "generation 1" in err
+    assert "rc=9" in err
+    on_disk = json.loads(
+        (sup.heartbeat_dir / "supervisor-report.json").read_text()
+    )
+    assert on_disk["result"] == "failed"
+
+
+def test_supervisor_main_exit_codes(tmp_path, monkeypatch):
+    script = tmp_path / "stub.py"
+    script.write_text(_STUB)
+    base = [
+        "--workers", "1",
+        "--heartbeat-dir", str(tmp_path / "ok"),
+        "--hang-sec", "30", "--backoff-sec", "0",
+        "--worker-cmd", f"{sys.executable} {script}",
+        "--", "--beta", "2",
+    ]
+    assert supervisor_main(base) == 0
+    c = json.loads((tmp_path / "ok" / "gen-000" / "contract-0.json").read_text())
+    assert c["argv"] == ["--beta", "2"]  # post-`--` args reach the worker
+
+    monkeypatch.setenv("STUB_CRASH_ALWAYS", "1")
+    rc = supervisor_main(
+        [
+            "--workers", "1", "--max-restarts", "0", "--backoff-sec", "0",
+            "--heartbeat-dir", str(tmp_path / "bad"),
+            "--worker-cmd", f"{sys.executable} {script}",
+        ]
+    )
+    assert rc == EXIT_BUDGET_EXHAUSTED
+
+
+def test_parse_fault_arg():
+    assert _parse_fault_arg("0:1:proc_kill@3") == ((0, 1), "proc_kill@3")
+    assert _parse_fault_arg("2:0:proc_hang@5,nan@7") == (
+        (2, 0),
+        "proc_hang@5,nan@7",
+    )
+    with pytest.raises(ValueError):
+        _parse_fault_arg("proc_kill@3")  # missing GEN:RANK prefix
+
+
+# ----------------------------------------------------------------------
+# proc_kill / proc_hang fault kinds
+# ----------------------------------------------------------------------
+
+
+def test_fault_plan_parses_process_kinds():
+    plan = faults.FaultPlan.parse("proc_kill@2,proc_hang@5")
+    assert plan.fire("proc_kill", 1) is False
+    assert plan.fire("proc_kill", 2) is True
+    assert plan.fire("proc_hang", 5) is True
+
+
+def test_proc_kill_terminates_without_drain(tmp_path):
+    # SIGKILL self at step K: no SIGTERM handler runs, no checkpoint, the
+    # process is simply gone — the preemption drill's hard sibling.
+    code = (
+        "from waternet_tpu.resilience import faults\n"
+        "faults.install(faults.FaultPlan.parse('proc_kill@2'))\n"
+        "faults.after_train_step(None, {}, 1)\n"
+        "print('step1-ok', flush=True)\n"
+        "faults.after_train_step(None, {}, 2)\n"
+        "print('unreachable', flush=True)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert r.returncode == -signal.SIGKILL
+    assert "step1-ok" in r.stdout
+    assert "unreachable" not in r.stdout
+
+
+def test_proc_hang_wedges_until_released():
+    faults.install(faults.FaultPlan.parse("proc_hang@1"))
+    passed = threading.Event()
+
+    def _step():
+        faults.after_train_step(None, {}, 1)
+        passed.set()
+
+    t = threading.Thread(target=_step, daemon=True)
+    t.start()
+    assert not passed.wait(0.3)  # wedged at step 1, not heartbeating
+    faults.clear()  # releases the latch (same protocol as replica_hang)
+    assert passed.wait(10.0)
+    t.join(10.0)
+
+
+# ----------------------------------------------------------------------
+# Heartbeats ride the deferred-metrics loop: no fetch, no recompile
+# ----------------------------------------------------------------------
+
+
+def test_heartbeat_in_epoch_control_is_recompile_free(tmp_path, compile_sentinel):
+    import numpy as np
+
+    from waternet_tpu.resilience.control import EpochControl
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    engine = TrainingEngine(
+        TrainConfig(
+            batch_size=8,
+            im_height=16,
+            im_width=16,
+            precision="fp32",
+            perceptual_weight=0.0,
+            augment=True,
+            shuffle=False,
+        )
+    )
+
+    def _batches(n, seed=0):
+        rng = np.random.default_rng(seed)
+        for _ in range(n):
+            yield (
+                rng.integers(0, 256, (8, 16, 16, 3), dtype=np.uint8),
+                rng.integers(0, 256, (8, 16, 16, 3), dtype=np.uint8),
+            )
+
+    writer = hb.HeartbeatWriter(
+        tmp_path / "worker-000.json", min_interval_sec=0.0
+    )
+    engine.train_epoch(_batches(1), epoch=0)  # warm-up: compiles once
+    compile_sentinel.arm_engine(engine)
+    engine.train_epoch(
+        _batches(3, seed=1), epoch=1, control=EpochControl(heartbeat=writer)
+    )
+    compile_sentinel.check()  # zero mid-epoch recompiles with beats on
+    rec = hb.read_heartbeat(writer.path)
+    assert rec is not None and rec["phase"] == "train"
+    assert rec["step"] == engine._host_step  # beat at every step boundary
+
+
+# ----------------------------------------------------------------------
+# End-to-end: 2-process gloo job, kill mid-epoch, byte-identical finish
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_supervised_2proc_kill_midepoch_bit_identical(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    base_cmd = [
+        sys.executable, str(REPO / "train.py"),
+        "--synthetic", "8", "--batch-size", "4",
+        "--height", "32", "--width", "32",
+        "--no-perceptual", "--precision", "fp32",
+        "--epochs", "3", "--checkpoint-every", "2", "--workers", "0",
+    ]
+    cfg = SupervisorConfig(
+        num_workers=2,
+        max_restarts=2,
+        backoff_base_sec=0.1,
+        backoff_cap_sec=0.5,
+        late_sec=20.0,
+        hang_sec=60.0,  # bounds detection if the survivor wedges in gloo
+        startup_grace_sec=300.0,
+        drain_grace_sec=15.0,
+        poll_sec=0.1,
+        heartbeat_sec=0.0,
+        cpu_gloo=True,
+    )
+    env = dict(os.environ)
+    env.pop("WATERNET_FAULTS", None)
+
+    def _run(tag, faults_map):
+        root = tmp_path / tag / "training"
+        sup = Supervisor(
+            base_cmd + ["--train-root", str(root)],
+            tmp_path / tag / "sup",
+            cfg,
+            env=env,
+            faults=faults_map,
+        )
+        return sup.run(), root
+
+    def _final_run(root):
+        runs = [d for d in root.iterdir() if (d / "metrics-train.csv").is_file()]
+        return max(runs, key=lambda d: int(d.name))
+
+    control, control_root = _run("control", {})
+    assert control["result"] == "completed" and control["restarts"] == 0
+
+    # kill rank 1 hard at global step 3 (mid-epoch 2 of 3, past the
+    # step-2 checkpoint): rank 0's collective dies or wedges, the
+    # supervisor tears the gang down and generation 1 resumes.
+    chaos, chaos_root = _run("chaos", {(0, 1): "proc_kill@3"})
+    assert chaos["result"] == "completed"
+    assert chaos["restarts"] == 1
+    trig = chaos["generations"][0]["trigger"]
+    assert "exited" in trig or "presumed hung" in trig
+
+    cd, xd = _final_run(control_root), _final_run(chaos_root)
+    for name in ("metrics-train.csv", "metrics-val.csv", "last.npz"):
+        assert (cd / name).read_bytes() == (xd / name).read_bytes(), name
+
+
+@pytest.mark.slow
+def test_bench_train_chaos_contract_line(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.remove(str(REPO))
+    line = bench.bench_train_chaos(job_dir=tmp_path / "job")
+    assert line["metric"] == "chaos_train_images_per_sec"
+    assert line["value"] > 0
+    assert line["workers"] == 2
+    assert line["result"] == "completed"
+    assert line["restarts"] == 2  # one kill + one hang, both recovered
+    assert line["control_restarts"] == 0
+    assert line["exact_resume"] is True  # byte-identical to the control
+    assert line["recovery_sec"] >= 0.0
+    assert line["steps_lost"] >= 0
+    assert line["generations"] == 3

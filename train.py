@@ -97,6 +97,10 @@ def parse_args(argv=None):
                    help="--distill: CAN student channel width (default 24)")
     p.add_argument("--student-depth", type=int, default=7,
                    help="--distill: CAN student 3x3 stage count (default 7; dilations 1,2,...,2^(depth-2),1)")
+    p.add_argument("--heartbeat-dir", type=str, metavar="DIR",
+                   help="Emit liveness heartbeats (one small JSON record per worker, atomically replaced at step boundaries, throttled by WATERNET_HEARTBEAT_SEC) into DIR for an external supervisor. Under waternet-launch this is set automatically via WATERNET_HEARTBEAT_DIR (docs/RESILIENCE.md 'Multi-process supervision')")
+    p.add_argument("--train-root", type=str, metavar="DIR",
+                   help="Base directory for the auto-numbered run dirs and --resume auto scanning (default: training/ next to train.py). waternet-launch jobs pass a job-scoped root so generations resume each other without touching unrelated runs")
     p.add_argument("--synthetic", type=int, default=0, metavar="N", help="Train on N synthetic pairs instead of reading a dataset")
     p.add_argument("--profile-dir", type=str, help="Capture a jax.profiler trace of the first post-compilation epoch (epoch 2, or epoch 1 when --epochs 1) into this dir")
     p.add_argument("--debug-nans", action="store_true", help="Enable jax NaN checking (slower; for debugging diverging runs)")
@@ -173,6 +177,20 @@ def main(argv=None):
     # Deterministic fault injection for resilience fire drills/tests
     # (WATERNET_FAULTS="nan@3,sigterm@10"); no-op without the env var.
     fault_plans.install_from_env()
+
+    # Supervision liveness (docs/RESILIENCE.md "Multi-process
+    # supervision"): --heartbeat-dir or the supervisor's env contract; None
+    # (and zero overhead) for unsupervised runs. The startup beat anchors
+    # the supervisor's startup grace before compilation begins.
+    from waternet_tpu.parallel.distributed import generation as restart_generation
+    from waternet_tpu.resilience.heartbeat import HeartbeatWriter
+
+    gen = restart_generation()
+    heartbeat = HeartbeatWriter.resolve(
+        args.heartbeat_dir, process_id=jax.process_index(), generation=gen
+    )
+    if heartbeat is not None:
+        heartbeat.beat(step=0, phase="startup", force=True)
 
     every_steps, every_secs = parse_checkpoint_interval(args.checkpoint_every)
     if every_secs and jax.process_count() > 1:
@@ -279,8 +297,11 @@ def main(argv=None):
     start_epoch = 0
     start_batch = 0
     carry = None
+    train_root = (
+        Path(args.train_root) if args.train_root else projectroot / "training"
+    )
     if args.resume == "auto":
-        resume_meta = auto_resume(engine, projectroot / "training")
+        resume_meta = auto_resume(engine, train_root)
         if resume_meta is None:
             print("No previous run state found; starting fresh")
         else:
@@ -302,7 +323,7 @@ def main(argv=None):
     elif args.resume:
         engine.restore(args.resume)
 
-    savedir = next_run_dir(projectroot / "training")
+    savedir = next_run_dir(train_root)
     manager = CheckpointManager(
         savedir / "checkpoints", keep=args.keep_checkpoints
     )
@@ -343,6 +364,8 @@ def main(argv=None):
             t0 = time.perf_counter()
             sb = start_batch if epoch == start_epoch else 0
             cy = carry if epoch == start_epoch else None
+            if heartbeat is not None:
+                heartbeat.epoch = epoch
             control = EpochControl(
                 preemption=guard,
                 sentinel=DivergenceSentinel() if args.nan_guard else None,
@@ -351,6 +374,7 @@ def main(argv=None):
                 ),
                 every_steps=every_steps,
                 every_secs=every_secs,
+                heartbeat=heartbeat,
             )
             try:
                 if args.device_cache:
@@ -395,12 +419,21 @@ def main(argv=None):
                     )
             except Preempted as p:
                 manager.save(engine, meta=_midepoch_meta(epoch, p.next_batch, p.partial))
+                if heartbeat is not None:
+                    heartbeat.beat(
+                        step=engine._host_step, phase="preempted", force=True
+                    )
                 print(
                     f"Preempted at epoch {epoch + 1}, batch {p.next_batch}; "
                     "checkpoint saved. Resume with --resume auto."
                 )
                 return
             train_dt = time.perf_counter() - t0
+            if heartbeat is not None:
+                # Val + epoch-end checkpointing emit no step beats; anchor
+                # the hang detector here (its threshold must cover val —
+                # see the --hang-sec guidance in waternet-launch).
+                heartbeat.beat(step=engine._host_step, phase="val", force=True)
             if args.device_cache:
                 val_metrics = engine.eval_epoch_cached(
                     dataset=dataset, indices=val_idx
@@ -478,6 +511,10 @@ def main(argv=None):
                     "val_psnr": float(val_metrics["psnr"]),
                 },
             )
+            if heartbeat is not None:
+                heartbeat.beat(
+                    step=engine._host_step, phase="epoch-end", force=True
+                )
             if guard.requested:
                 # Signal arrived during val/checkpointing: the epoch-end
                 # checkpoint above already captured everything.
@@ -487,6 +524,8 @@ def main(argv=None):
                 )
                 return
 
+    if heartbeat is not None:
+        heartbeat.beat(step=engine._host_step, phase="done", force=True)
     if jax.process_index() != 0:
         return
     savedir.mkdir(parents=True, exist_ok=True)  # --epochs 0: loop never ran
@@ -520,6 +559,10 @@ def main(argv=None):
                 "shuffle": config.shuffle,
                 "augment": config.augment,
                 "device_preprocess": config.device_preprocess,
+                # Supervision provenance: which restart generation finished
+                # the run, and over how many processes (docs/RESILIENCE.md).
+                "restart_generation": gen,
+                "num_processes": jax.process_count(),
                 "distill": config.distill,
                 "student_width": config.student_width if config.distill else None,
                 "student_depth": config.student_depth if config.distill else None,

@@ -22,20 +22,93 @@ everything below is a no-op passthrough.
 
 from __future__ import annotations
 
-from typing import Optional
+import os
+from typing import NamedTuple, Optional
 
 import jax
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Restart-context env contract (docs/RESILIENCE.md "Multi-process
+# supervision"): the supervisor (waternet_tpu/resilience/supervisor.py)
+# stamps these into each worker's environment, a fresh coordinator port
+# and generation per relaunch; :func:`initialize` with no explicit args
+# consumes them. Absent all of them, behavior is byte-identical to the
+# historical single-process / TPU-auto-discovery path.
+# ---------------------------------------------------------------------------
+ENV_COORDINATOR = "WATERNET_COORDINATOR"
+ENV_NUM_PROCESSES = "WATERNET_NUM_PROCESSES"
+ENV_PROCESS_ID = "WATERNET_PROCESS_ID"
+ENV_GENERATION = "WATERNET_GENERATION"
+#: CPU rehearsal flag: gloo collectives + serialized dispatch (the PR-5
+#: transport constraint — one collective stream per rank or gloo crashes
+#: with ``op.preamble.length <= op.nbytes``).
+ENV_CPU_GLOO = "WATERNET_CPU_GLOO"
+#: Bounded coordinator-connect timeout (seconds) for explicit mode.
+ENV_CONNECT_TIMEOUT = "WATERNET_CONNECT_TIMEOUT_SEC"
+
+_CONTEXT_VARS = (ENV_COORDINATOR, ENV_NUM_PROCESSES, ENV_PROCESS_ID)
+
+
+class RestartContext(NamedTuple):
+    """One worker's identity within a supervised (possibly relaunched) job."""
+
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    generation: int
+
+
+def restart_context(env=None) -> Optional[RestartContext]:
+    """Parse the supervisor's env contract; None when absent.
+
+    A *partial* contract (some of the three identity vars set, others not)
+    is a wiring bug that would silently train N duplicate single-process
+    runs — it raises, naming exactly what is set and what is missing.
+    """
+    env = os.environ if env is None else env
+    present = {v: env.get(v) for v in _CONTEXT_VARS if env.get(v) is not None}
+    if not present:
+        return None
+    if len(present) != len(_CONTEXT_VARS):
+        missing = [v for v in _CONTEXT_VARS if v not in present]
+        raise ValueError(
+            f"partial multi-process restart context: {present} set but "
+            f"{missing} missing — the supervisor must provide all of "
+            f"{_CONTEXT_VARS}"
+        )
+    return RestartContext(
+        coordinator_address=env[ENV_COORDINATOR],
+        num_processes=int(env[ENV_NUM_PROCESSES]),
+        process_id=int(env[ENV_PROCESS_ID]),
+        generation=int(env.get(ENV_GENERATION, "0")),
+    )
+
+
+def generation(env=None) -> int:
+    """The restart generation this process belongs to (0 unsupervised)."""
+    env = os.environ if env is None else env
+    return int(env.get(ENV_GENERATION, "0"))
 
 
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    connect_timeout_sec: Optional[float] = None,
 ) -> None:
     """Idempotent `jax.distributed.initialize` (no-op when single-process
     or already initialized). On TPU pods all arguments are discovered from
-    the environment; set them explicitly only for CPU/GPU multi-process.
+    the environment; set them explicitly only for CPU/GPU multi-process —
+    or run under ``waternet-launch``, whose restart-context env vars
+    (:func:`restart_context`) are consumed here, generation-aware: each
+    relaunched generation re-initializes against its own fresh coordinator.
+
+    Explicit-mode failures are bounded (``connect_timeout_sec``, default
+    from ``WATERNET_CONNECT_TIMEOUT_SEC`` else jax's 300 s) and re-raised
+    naming the coordinator, this process's id/count, the generation, and
+    the env vars consulted — instead of a bare jax traceback after an
+    unbounded wait.
 
     Must be called before any other jax API (anything that initializes the
     XLA backend makes `jax.distributed.initialize` impossible — so this
@@ -53,13 +126,28 @@ def initialize(
             return  # already initialized
     except (ImportError, AttributeError):  # pragma: no cover
         pass
+    ctx = None
+    if coordinator_address is None and num_processes is None:
+        ctx = restart_context()  # partial contract raises here, loudly
+        if ctx is not None:
+            coordinator_address = ctx.coordinator_address
+            num_processes = ctx.num_processes
+            process_id = ctx.process_id
     explicit = coordinator_address is not None or num_processes is not None
+    if explicit and os.environ.get(ENV_CPU_GLOO, "") in ("1", "true"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+    if connect_timeout_sec is None:
+        timeout = float(os.environ.get(ENV_CONNECT_TIMEOUT, "300"))
+    else:
+        timeout = float(connect_timeout_sec)
     try:
         if explicit:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
+                initialization_timeout=max(1, int(timeout)),
             )
         else:
             # TPU pod auto-discovery; fails benignly on plain single hosts.
@@ -69,8 +157,21 @@ def initialize(
             return  # idempotence backstop when the private-state check above
             # was unavailable
         if explicit:
-            raise  # user asked for multi-process; failing silently would
-            # let every host train an independent duplicate run
+            # User asked for multi-process; failing silently would let every
+            # host train an independent duplicate run. Name everything the
+            # operator needs to debug the join.
+            gen = ctx.generation if ctx is not None else generation()
+            consulted = ", ".join(
+                f"{v}={os.environ.get(v)!r}"
+                for v in (*_CONTEXT_VARS, ENV_GENERATION, ENV_CPU_GLOO)
+            )
+            raise RuntimeError(
+                f"multi-process init failed: process "
+                f"{process_id}/{num_processes} could not join coordinator "
+                f"{coordinator_address} within {timeout:.0f}s "
+                f"(restart generation {gen}; {type(e).__name__}: {e}). "
+                f"Env consulted: {consulted}"
+            ) from e
         import sys
 
         print(

@@ -16,7 +16,13 @@ pipelines:
 * :mod:`control` — the per-epoch bundle the trainer's epoch driver consults
   at step boundaries (:class:`EpochControl`);
 * :mod:`faults` — the deterministic fault-injection harness the resilience
-  tests drive (env var ``WATERNET_FAULTS`` or programmatic plans).
+  tests drive (env var ``WATERNET_FAULTS`` or programmatic plans);
+* :mod:`heartbeat` — step-boundary liveness records + the per-worker
+  health state machine (:class:`HeartbeatWriter`, :class:`WorkerHealth`);
+* :mod:`supervisor` — the ``waternet-launch`` gang supervisor: spawn N
+  train.py workers, detect crash/hang/preemption via heartbeats, drain
+  survivors, and relaunch generations that resume from the latest
+  complete checkpoint (:class:`Supervisor`).
 
 Everything here is multi-host-aware: checkpoint saves stay process-collective
 (each process calls them; process 0 alone touches the filesystem markers),
@@ -25,6 +31,7 @@ so every process takes the same branch. See docs/RESILIENCE.md.
 """
 
 from waternet_tpu.resilience.control import EpochControl
+from waternet_tpu.resilience.heartbeat import HeartbeatWriter, WorkerHealth
 from waternet_tpu.resilience.manager import CheckpointManager, auto_resume
 from waternet_tpu.resilience.preemption import Preempted, PreemptionGuard
 from waternet_tpu.resilience.sentinel import DivergenceError, DivergenceSentinel
@@ -34,7 +41,9 @@ __all__ = [
     "DivergenceError",
     "DivergenceSentinel",
     "EpochControl",
+    "HeartbeatWriter",
     "Preempted",
     "PreemptionGuard",
+    "WorkerHealth",
     "auto_resume",
 ]

@@ -30,6 +30,11 @@ class EpochControl:
     checkpoint_cb: Optional[Callable[[int, list], None]] = None
     every_steps: int = 0
     every_secs: float = 0.0
+    # Supervision liveness (docs/RESILIENCE.md "Multi-process
+    # supervision"): a HeartbeatWriter the driver ticks once per
+    # dispatched step — pure host work riding the deferred-metrics loop
+    # (no device fetch), throttled inside the writer.
+    heartbeat: Optional[object] = None
     _steps_since_ckpt: int = 0
     _last_ckpt_time: float = dataclasses.field(default_factory=time.monotonic)
 

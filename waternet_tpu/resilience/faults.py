@@ -11,6 +11,20 @@ is a set of one-shot events, each keyed by a deterministic counter:
 * ``sigterm@K`` — deliver a real SIGTERM to this process after global step
   K, exercising the actual signal path of
   :class:`waternet_tpu.resilience.preemption.PreemptionGuard`.
+* ``proc_kill@K`` — the process self-terminates HARD (SIGKILL to itself)
+  after global step K: no drain, no checkpoint, no atexit — the faithful
+  signature of an OOM kill or an unannounced VM preemption. The training
+  supervisor (docs/RESILIENCE.md "Multi-process supervision") must detect
+  the exit and restart the gang from the last complete checkpoint.
+* ``proc_hang@K`` — the process wedges after global step K *without
+  heartbeating*: the dispatch thread blocks on a release latch, so step
+  progress and heartbeat emission both stop while the process stays
+  alive — the faithful signature of a stuck collective or a wedged
+  device. The supervisor must detect this by heartbeat timeout (never by
+  waiting on the collective). Releasable like ``replica_hang``: the
+  wedged thread wakes on :func:`clear` / :func:`install`, so in-process
+  tests stay joinable; under the supervisor nothing clears the plan and
+  the worker is SIGKILLed after the drain grace.
 * ``truncate_ckpt@K`` — after the K-th (1-based) finalized checkpoint save,
   truncate its largest payload file, simulating a mid-write crash or torn
   volume that the marker protocol alone cannot see.
@@ -104,7 +118,8 @@ class FaultPlan:
     """One-shot fault events keyed by (kind, ordinal)."""
 
     KINDS = (
-        "nan", "sigterm", "truncate_ckpt", "decode",
+        "nan", "sigterm", "proc_kill", "proc_hang", "truncate_ckpt",
+        "decode",
         "slow_replica", "replica_crash", "replica_hang", "nan_output",
         "reject_admit", "stream_stall", "stream_disconnect",
         "frame_corrupt",
@@ -213,6 +228,18 @@ def after_train_step(engine, metrics, global_step: int):
         metrics = {k: float("nan") for k in metrics}
     if _PLAN.fire("sigterm", global_step):
         os.kill(os.getpid(), signal.SIGTERM)
+    if _PLAN.fire("proc_kill", global_step):
+        # Hard self-terminate: no drain, no checkpoint, no Python teardown
+        # (SIGKILL is uncatchable) — an OOM kill / unannounced preemption.
+        os.kill(os.getpid(), signal.SIGKILL)
+    with _SERVE_LOCK:
+        hang = _HANG_RELEASE if _PLAN.fire("proc_hang", global_step) else None
+    if hang is not None:
+        # Wedge without heartbeating: block the dispatch thread on the
+        # plan's release latch (same contract as replica_hang — clear()/
+        # install() release it, so in-process tests stay joinable; under
+        # the supervisor nothing does, and the heartbeat timeout reaps us).
+        hang.wait()
     return metrics
 
 

@@ -31,11 +31,18 @@ is collective); markers, pruning, and fault hooks run on process 0 only.
 from __future__ import annotations
 
 import json
+import re
 import shutil
 from pathlib import Path
 from typing import NamedTuple, Optional
 
 MARKER = "_COMPLETE.json"
+
+#: A *finalized* step dir is exactly ``step-<digits>``. Anything else the
+#: glob can catch — ``step-42.tmp`` / ``step-42.orbax-checkpoint-tmp-...``
+#: staging conventions of a concurrently-finalizing peer generation — is
+#: in-progress by construction and must never be scanned as a checkpoint.
+_STEP_DIR = re.compile(r"step-\d+")
 
 
 class Checkpoint(NamedTuple):
@@ -107,18 +114,30 @@ class CheckpointManager:
     # ------------------------------------------------------------------
 
     def checkpoints(self) -> list:
-        """Complete (marker-finalized) checkpoints, ascending by step."""
+        """Complete (marker-finalized) checkpoints, ascending by step.
+
+        Concurrency-tolerant by construction: a restarting peer generation
+        may be finalizing (``*.tmp`` staging) or pruning (entries vanish
+        between the glob and the marker read) this very directory. Staging
+        names are rejected by pattern; a vanished/torn marker read raises
+        ``OSError``/``JSONDecodeError`` and the entry is simply skipped —
+        the marker protocol guarantees anything skipped was not (or no
+        longer is) a complete checkpoint.
+        """
         out = []
-        if not self.root.is_dir():
+        try:
+            entries = sorted(self.root.glob("step-*"))
+        except OSError:  # root itself vanished mid-scan
             return out
-        for p in sorted(self.root.glob("step-*")):
-            marker = p / MARKER
-            if not (p.is_dir() and marker.is_file()):
-                continue
+        for p in entries:
+            if not _STEP_DIR.fullmatch(p.name):
+                continue  # in-progress staging dir, never a checkpoint
+            # No is_dir/is_file pre-checks: they would only widen the
+            # check-to-read race. The read itself is the check.
             try:
-                meta = json.loads(marker.read_text())
+                meta = json.loads((p / MARKER).read_text())
             except (OSError, json.JSONDecodeError):
-                continue
+                continue  # unfinalized, torn, or vanished mid-scan
             out.append(Checkpoint(p, int(meta.get("step", -1)), meta))
         out.sort(key=lambda ck: ck.step)
         return out
@@ -138,6 +157,9 @@ class CheckpointManager:
         from waternet_tpu.training.trainer import CheckpointMismatchError
 
         for ck in reversed(self.checkpoints()):
+            if not ck.state_dir.is_dir():
+                continue  # pruned by a peer between the scan and this
+                # restore attempt: not corruption, just gone — skip quietly
             try:
                 engine.restore(ck.state_dir)
                 return ck

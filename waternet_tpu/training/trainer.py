@@ -1230,6 +1230,11 @@ class TrainingEngine:
             pending.append((count, payload, dispatch(count, payload)))
             if control is None:
                 continue
+            if control.heartbeat is not None:
+                # Liveness for the supervisor: host-side only (the step just
+                # dispatched ASYNCHRONOUSLY; nothing is fetched here), so
+                # the deferred-metrics discipline and step time are intact.
+                control.heartbeat.beat(step=self._host_step)
             if sentinel is not None and len(pending) >= sentinel.window:
                 verify()
             if control.preempt_requested():
