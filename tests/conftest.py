@@ -39,34 +39,86 @@ from waternet_tpu.utils.platform import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
 
+import sys  # noqa: E402
+import threading as _threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Stamp every thread with its spawn site (file:line of the .start() call)
+# so the leak guard below can say WHERE a leaked thread came from, not
+# just its name. The wrapper adds one frame lookup per thread start —
+# nothing on the thread's own hot path.
+_orig_thread_start = _threading.Thread.start
+
+
+def _start_with_spawn_site(self):
+    f = sys._getframe(1)
+    self._spawn_site = f"{f.f_code.co_filename}:{f.f_lineno}"
+    return _orig_thread_start(self)
+
+
+_threading.Thread.start = _start_with_spawn_site
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _describe_thread(t) -> str:
+    return f"{t.name} (spawned at {getattr(t, '_spawn_site', '<unknown>')})"
 
 
 @pytest.fixture(autouse=True)
 def _no_pipeline_worker_leak():
     """Thread-leak guard: after every test, no input-pipeline worker thread
     may survive (waternet_tpu/data/pipeline.py names them all under
-    THREAD_PREFIX). A leaked worker means a shutdown bug — an abandoned
+    THREAD_PREFIX), and no non-daemon thread spawned from repo code may
+    linger either. A leaked worker means a shutdown bug — an abandoned
     OrderedPipeline/PrefetchIterator that was never close()d — which tier-1
     would otherwise miss entirely: the suite would pass and the leak would
-    only surface as a hang or fd exhaustion in production."""
+    only surface as a hang or fd exhaustion in production. Each leaked
+    thread is reported with its spawn site (see _start_with_spawn_site)."""
     import threading
 
     yield
     from waternet_tpu.data.pipeline import THREAD_PREFIX
 
-    leaked = [
-        t for t in threading.enumerate() if t.name.startswith(THREAD_PREFIX)
-    ]
+    def _suspect(t):
+        if t is threading.main_thread() or not t.is_alive():
+            return False
+        if t.name.startswith(THREAD_PREFIX):
+            return True
+        # Non-daemon stragglers spawned from repo code (serving pools,
+        # batcher dispatchers, probe threads...). Third-party/daemon
+        # helpers (jax, logging, pytest plumbing) are out of scope.
+        site = getattr(t, "_spawn_site", "")
+        return (not t.daemon) and site.startswith(_REPO_ROOT)
+
+    leaked = [t for t in threading.enumerate() if _suspect(t)]
     for t in leaked:  # grace for threads mid-exit from a racing shutdown
         t.join(timeout=2.0)
-    leaked = [
-        t.name
-        for t in threading.enumerate()
-        if t.name.startswith(THREAD_PREFIX)
-    ]
-    assert not leaked, f"leaked pipeline worker threads: {leaked}"
+    leaked = [_describe_thread(t) for t in threading.enumerate() if _suspect(t)]
+    assert not leaked, f"leaked worker threads: {leaked}"
+
+
+@pytest.fixture
+def locktrace():
+    """Dynamic lock-order watchdog (docs/LINT.md 'Concurrency rules'):
+    every ``threading.Lock``/``RLock`` created during the test is traced;
+    a thread acquiring lock B while holding lock A records an ordered
+    edge keyed by the locks' creation sites. Teardown fails the test if
+    the observed edges form a cycle — the runtime companion of jaxlint
+    R102, catching orders induced through callbacks and executor threads
+    that static call-graph propagation cannot see. Opt in per module with
+    ``pytestmark = pytest.mark.usefixtures("locktrace")``."""
+    from waternet_tpu.analysis.locktrace import LockTracer
+
+    tracer = LockTracer()
+    tracer.install()
+    try:
+        yield tracer
+    finally:
+        tracer.uninstall()
+    tracer.assert_acyclic()
 
 
 class CompileSentinel:

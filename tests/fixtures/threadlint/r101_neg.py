@@ -1,0 +1,54 @@
+"""R101 negative: the same shapes done right.
+
+Declared attributes are written under their declared lock (or in a
+method whose def-line carries the caller-holds guard); ``__init__``
+writes are exempt by construction happens-before; a ``queue.Queue``
+attribute locks internally and needs no guard; a non-thread-bearing
+class may mutate its own state freely.
+"""
+
+import queue
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: self._lock
+        self.pending = []  # guarded-by: self._lock
+        self.inbox = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            pass
+
+    def bump(self, n):
+        with self._lock:
+            self.total += n
+
+    def enqueue(self, item):
+        with self._lock:
+            self.pending.append(item)
+        self.inbox.put(item)
+
+    def _drain_locked(self):  # guarded-by: self._lock
+        drained = list(self.pending)
+        self.pending = []
+        return drained
+
+    def drain(self):
+        with self._lock:
+            return self._drain_locked()
+
+
+class SingleThreaded:
+    """No threads anywhere: mutating shared state needs no locks."""
+
+    def __init__(self):
+        self.items = []
+
+    def add(self, x):
+        self.items.append(x)
+        self.items = sorted(self.items)

@@ -1,0 +1,37 @@
+"""R101 positive: unguarded shared mutation, both arms.
+
+Arm 1: an attribute declared ``# guarded-by: self._lock`` is written in
+a non-``__init__`` method with no lock held.  Arm 2: an undeclared
+read-modify-write of shared state in a thread-bearing class with no
+lock held.  Threads are daemon so R105 stays quiet; nothing blocks under
+a lock so R103 stays quiet.
+"""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0  # guarded-by: self._lock
+        self.pending = []
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            pass
+
+    def bump(self, n):
+        self.total += n  # BAD: declared guarded, lock not held
+
+    def reset(self):
+        self.total = 0  # BAD: declared guarded, lock not held
+
+    def enqueue(self, item):
+        self.pending.append(item)  # BAD: undeclared shared mutation
+
+    def drain_count(self):
+        count = 0
+        count += 1  # fine: local, not self.*
+        return count

@@ -1,0 +1,39 @@
+"""R102 negative: many locks, one global order.
+
+Every path takes the locks in the same A -> B -> C order — including
+the call-propagated one — so the acquisition graph is a DAG.
+Re-acquiring nothing, self-nesting nothing.
+"""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+LOCK_C = threading.Lock()
+
+
+def step_ab():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def step_bc():
+    with LOCK_B:
+        with LOCK_C:
+            pass
+
+
+def _take_c():
+    with LOCK_C:
+        pass
+
+
+def step_ac_via_call():
+    with LOCK_A:
+        _take_c()  # A -> C: consistent with the global order
+
+
+def step_a_only():
+    with LOCK_A:
+        pass

@@ -1,0 +1,41 @@
+"""R102 positive: two independent lock-order inversions.
+
+Cycle 1 is direct (nested ``with`` blocks in opposite orders); cycle 2
+goes through a call made under a lock — the shape static nesting alone
+would miss.  Two cycles -> two findings.
+"""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+LOCK_C = threading.Lock()
+LOCK_D = threading.Lock()
+
+
+def transfer_ab():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def transfer_ba():
+    with LOCK_B:
+        with LOCK_A:  # BAD: opposite order to transfer_ab
+            pass
+
+
+def _take_c():
+    with LOCK_C:
+        pass
+
+
+def audit_dc():
+    with LOCK_D:
+        _take_c()  # acquires C under D
+
+
+def audit_cd():
+    with LOCK_C:
+        with LOCK_D:  # BAD: opposite order to audit_dc's call chain
+            pass

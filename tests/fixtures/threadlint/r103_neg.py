@@ -1,0 +1,48 @@
+"""R103 negative: the exempt shapes.
+
+``dict.get(key)`` and ``str.join(iterable)`` are lookups, not blocking
+calls; ``queue.get(block=False)`` cannot block; blocking work done
+*outside* the locked region (snapshot under the lock, block after
+releasing) is the pattern the rule's message prescribes; and
+``Condition.wait`` under its own condition is the sanctioned use (wait
+releases the lock) — in a while loop so R104 stays quiet too.
+"""
+
+import threading
+
+_LOCK = threading.Lock()
+_COND = threading.Condition()
+_READY = []
+
+
+def lookup(table, key):
+    with _LOCK:
+        return table.get(key)  # dict.get: never blocks
+
+
+def render(parts):
+    with _LOCK:
+        return ", ".join(parts)  # str.join: never blocks
+
+
+def poll(q):
+    with _LOCK:
+        return q.get(block=False)  # non-blocking get
+
+
+def publish_then_wait(results, fut):
+    with _LOCK:
+        results.append("pending")
+    results.append(fut.result())  # blocks AFTER the lock is released
+
+
+def shutdown(worker):
+    with _LOCK:
+        stale = worker
+    stale.join()  # blocks after releasing
+
+
+def await_ready():
+    with _COND:
+        while not _READY:
+            _COND.wait()  # sanctioned: wait releases _COND

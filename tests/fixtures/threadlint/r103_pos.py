@@ -1,0 +1,30 @@
+"""R103 positive: blocking calls while a lock is held.
+
+Each one stalls every thread contending for the lock for as long as the
+blocked operation takes — the classic convoy/deadlock feeder.
+"""
+
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+
+def slow_publish(results, fut):
+    with _LOCK:
+        results.append(fut.result())  # BAD: Future.result() under lock
+
+
+def sleepy_retry():
+    with _LOCK:
+        time.sleep(0.5)  # BAD: parks the thread while holding the lock
+
+
+def drain(q, out):
+    with _LOCK:
+        out.append(q.get())  # BAD: queue get() blocks under the lock
+
+
+def shutdown(worker):
+    with _LOCK:
+        worker.join()  # BAD: Thread.join() under the lock

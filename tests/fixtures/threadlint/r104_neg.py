@@ -1,0 +1,29 @@
+"""R104 negative: predicate-looped waits (and non-condition .wait()).
+
+The ``while not <pred>: cond.wait()`` shape re-checks after every
+wakeup; ``wait_for`` embeds the loop; an Event's ``.wait()`` has no
+predicate contract and is not a Condition.
+"""
+
+import threading
+
+_COND = threading.Condition()
+_ITEMS = []
+_DONE = threading.Event()
+
+
+def take_one():
+    with _COND:
+        while not _ITEMS:
+            _COND.wait()
+        return _ITEMS.pop()
+
+
+def take_one_wait_for():
+    with _COND:
+        _COND.wait_for(lambda: bool(_ITEMS))
+        return _ITEMS.pop()
+
+
+def await_done():
+    _DONE.wait()  # Event.wait: no predicate contract, not a Condition

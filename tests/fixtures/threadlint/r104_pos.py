@@ -1,0 +1,26 @@
+"""R104 positive: Condition.wait() without a while-loop predicate.
+
+Wakeups can be spurious, and a notify sent before the wait is lost —
+the condition contract requires re-checking the predicate in a loop.
+One module-level condition, one local: both recognized statically.
+"""
+
+import threading
+
+_COND = threading.Condition()
+_ITEMS = []
+
+
+def take_one_if():
+    with _COND:
+        if not _ITEMS:
+            _COND.wait()  # BAD: `if` loses spurious/early wakeups
+        return _ITEMS.pop()
+
+
+def take_one_bare():
+    cond = threading.Condition()
+    items = []
+    with cond:
+        cond.wait()  # BAD: no predicate check at all
+        return items

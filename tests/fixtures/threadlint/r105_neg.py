@@ -1,0 +1,45 @@
+"""R105 negative: every started thread is accounted for.
+
+Joined in-function, joined by another method on the shutdown path
+(``self.attr`` refs search the whole module), daemonized via the
+constructor kwarg or attribute, or registered with a leak guard.
+"""
+
+import threading
+
+_GUARD = []
+
+
+def tick():
+    pass
+
+
+def launch_and_join():
+    t = threading.Thread(target=tick)
+    t.start()
+    t.join(timeout=5.0)
+
+
+def launch_daemon_kwarg():
+    threading.Thread(target=tick, daemon=True).start()
+
+
+def launch_daemon_attr():
+    t = threading.Thread(target=tick)
+    t.daemon = True
+    t.start()
+
+
+def launch_registered():
+    t = threading.Thread(target=tick)
+    _GUARD.append(t)
+    t.start()
+
+
+class Worker:
+    def __init__(self):
+        self._thread = threading.Thread(target=tick)
+        self._thread.start()
+
+    def close(self):
+        self._thread.join()  # module-wide search finds the shutdown join

@@ -1,0 +1,28 @@
+"""R105 positive: non-daemon threads started and abandoned.
+
+One is bound to a local that is never joined (another function joining
+its *own* ``t`` does not count); one is started without being bound at
+all, so no one can ever join it.  Process exit hangs on both.
+"""
+
+import threading
+
+
+def tick():
+    pass
+
+
+def launch_bound():
+    t = threading.Thread(target=tick)
+    t.start()  # BAD: bound but never joined in this function
+    return None
+
+
+def launch_unbound():
+    threading.Thread(target=tick).start()  # BAD: unbound, unjoinable
+
+
+def launch_and_join():
+    t = threading.Thread(target=tick)
+    t.start()
+    t.join()  # this one is fine — and must not excuse launch_bound's t

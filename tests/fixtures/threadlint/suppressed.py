@@ -1,0 +1,21 @@
+"""Suppression contract for the R10x family: both comment forms silence
+the finding but it is still counted (reviewers see the tally)."""
+
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+
+def deliberate_sleep_under_lock():
+    with _LOCK:
+        time.sleep(0.01)  # jaxlint: disable=R103 fixed tiny backoff, held <10ms by test design
+
+
+def tick():
+    pass
+
+
+def fire_and_forget():
+    # jaxlint: disable-next=R105 interpreter-lifetime helper, exits with the process
+    threading.Thread(target=tick).start()

@@ -43,6 +43,11 @@ sys.path.insert(0, str(REPO))
 
 from tools.distill_fixture import FIXTURE_DIR  # noqa: E402
 
+# Lock-order watchdog on the whole threaded suite: every test runs with
+# instrumented locks; an observed lock-order cycle fails the test
+# (docs/LINT.md "Concurrency rules", tests/conftest.py::locktrace).
+pytestmark = pytest.mark.usefixtures("locktrace")
+
 BUCKET = (32, 32)
 
 

@@ -33,6 +33,11 @@ from waternet_tpu.serving import (
 
 REPO = Path(__file__).resolve().parent.parent
 
+# Lock-order watchdog on the whole threaded suite: every test runs with
+# instrumented locks; an observed lock-order cycle fails the test
+# (docs/LINT.md "Concurrency rules", tests/conftest.py::locktrace).
+pytestmark = pytest.mark.usefixtures("locktrace")
+
 #: Conservative floor for the reflect-padded seam band (uint8 PSNR vs the
 #: native forward). Measured ~28 dB with random params; real weights are
 #: smoother. The policy is "bounded", the pin is "never worse than this".

@@ -40,6 +40,11 @@ from waternet_tpu.resilience.supervisor import main as supervisor_main
 
 REPO = Path(__file__).resolve().parent.parent
 
+# Lock-order watchdog on the whole threaded suite: every test runs with
+# instrumented locks; an observed lock-order cycle fails the test
+# (docs/LINT.md "Concurrency rules", tests/conftest.py::locktrace).
+pytestmark = pytest.mark.usefixtures("locktrace")
+
 
 @pytest.fixture(autouse=True)
 def _clear_faults(monkeypatch):

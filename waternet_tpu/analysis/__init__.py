@@ -1,23 +1,34 @@
-"""jaxlint — static analysis for JAX-specific hazards (docs/LINT.md).
+"""jaxlint — static analysis for JAX and concurrency hazards (docs/LINT.md).
 
 Pure-AST: linting never imports the linted code, so it runs anywhere (no
 accelerator, no jax session) and is safe inside the tier-1 budget. The
 rules encode invariants the repo otherwise enforces only by convention
 or by expensive dynamic tests:
 
-======  =====================  ==================================================
-R001    donation-after-use     donated buffer read after the call / aliases host
-R002    rng-key-reuse          PRNG key consumed twice without split/fold_in
-R003    host-sync-in-hot-loop  .item()/float()/np.asarray in a dispatching loop
-R004    recompile-hazard       unhashable statics, jit-in-loop, traced branches
-R005    tracer-leak            traced values stored into self/globals/closures
-======  =====================  ==================================================
+======  ===============================  ==================================================
+R001    donation-after-use               donated buffer read after the call / aliases host
+R002    rng-key-reuse                    PRNG key consumed twice without split/fold_in
+R003    host-sync-in-hot-loop            .item()/float()/np.asarray in a dispatching loop
+R004    recompile-hazard                 unhashable statics, jit-in-loop, traced branches
+R005    tracer-leak                      traced values stored into self/globals/closures
+R101    unguarded-shared-mutation        `# guarded-by:` attr written outside its lock
+R102    lock-order-inversion             cycle in the whole-repo lock-acquisition graph
+R103    blocking-call-under-lock         result()/join()/get()/sleep/host-sync under a lock
+R104    condition-wait-without-predicate Condition.wait() not re-checked in a while loop
+R105    unjoined-thread                  non-daemon Thread started with no join/leak guard
+======  ===============================  ==================================================
 
 Suppress a deliberate pattern with ``# jaxlint: disable=R00x <why>`` on
 the line (or ``disable-next=`` on the line above); the justification text
 is free-form and strongly encouraged. ``tests/test_jaxlint.py::
-test_repo_clean`` asserts zero unsuppressed findings over the package and
-the CLIs, so every new hazard is either fixed or visibly argued for.
+test_repo_clean`` and ``tests/test_threadlint.py::test_repo_clean``
+assert zero unsuppressed findings over the package and the CLIs, so
+every new hazard is either fixed or visibly argued for.
+
+R102 is project-scope: it builds one static lock-acquisition graph over
+every scanned module (nested ``with``/``acquire`` sites plus calls made
+while holding a lock) and flags its cycles. ``jaxlint --lock-graph``
+renders the same graph as DOT.
 """
 
 from __future__ import annotations
@@ -26,6 +37,10 @@ import ast
 from pathlib import Path
 from typing import Iterable, Optional
 
+from waternet_tpu.analysis.concurrency import (  # noqa: F401
+    LockGraph,
+    build_lock_graph,
+)
 from waternet_tpu.analysis.core import (  # noqa: F401
     Finding,
     ModuleModel,
@@ -33,8 +48,32 @@ from waternet_tpu.analysis.core import (  # noqa: F401
     is_suppressed,
     suppressions,
 )
-from waternet_tpu.analysis.registry import RULES, run_rules  # noqa: F401
+from waternet_tpu.analysis.registry import (  # noqa: F401
+    RULES,
+    run_project_rules,
+    run_rules,
+)
 import waternet_tpu.analysis.rules  # noqa: F401  (registers the rules)
+
+
+def parse_model(path) -> ModuleModel:
+    """Parse one file into a :class:`ModuleModel` (raises SyntaxError)."""
+    source = Path(path).read_text(encoding="utf-8")
+    tree = ast.parse(source, filename=str(path))
+    return ModuleModel(str(path), source, tree)
+
+
+def lint_models(models, rules: Optional[Iterable[str]] = None) -> list:
+    """Module rules per model, then the project rules over all of them,
+    with per-file suppression state resolved."""
+    findings = []
+    for model in models:
+        findings.extend(run_rules(model, rules))
+    findings.extend(run_project_rules(models, rules))
+    supp_by_path = {m.path: suppressions(m.source) for m in models}
+    for f in findings:
+        f.suppressed = is_suppressed(f, supp_by_path.get(f.path, {}))
+    return findings
 
 
 def lint_source(
@@ -43,14 +82,13 @@ def lint_source(
     rules: Optional[Iterable[str]] = None,
 ) -> list:
     """Lint one module's source text; returns findings with suppression
-    state resolved. Raises ``SyntaxError`` when the source doesn't parse
-    (the CLI maps that to exit code 2)."""
+    state resolved (project rules run over the one-module project).
+    Raises ``SyntaxError`` when the source doesn't parse (the CLI maps
+    that to exit code 2)."""
     tree = ast.parse(source, filename=str(path))
     model = ModuleModel(path, source, tree)
-    findings = run_rules(model, rules)
-    supp = suppressions(source)
-    for f in findings:
-        f.suppressed = is_suppressed(f, supp)
+    findings = lint_models([model], rules)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 
 
@@ -61,9 +99,8 @@ def lint_file(path, rules: Optional[Iterable[str]] = None) -> list:
 
 
 def lint_paths(paths: Iterable, rules: Optional[Iterable[str]] = None):
-    """Lint files/directories; returns ``(findings, files_scanned)``."""
+    """Lint files/directories as ONE project (R102 sees the whole set);
+    returns ``(findings, files_scanned)``."""
     files = collect_py_files(paths)
-    findings = []
-    for f in files:
-        findings.extend(lint_file(f, rules))
-    return findings, len(files)
+    models = [parse_model(f) for f in files]
+    return lint_models(models, rules), len(files)

@@ -11,7 +11,11 @@ import argparse
 import sys
 from typing import Optional
 
-from waternet_tpu.analysis import lint_file
+from waternet_tpu.analysis import (
+    build_lock_graph,
+    lint_models,
+    parse_model,
+)
 from waternet_tpu.analysis.core import collect_py_files
 from waternet_tpu.analysis.registry import RULES
 from waternet_tpu.analysis.report import render_json, render_text
@@ -21,9 +25,11 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(
         prog="jaxlint",
         description=(
-            "Static analysis for JAX-specific hazards: buffer donation, "
+            "Static analysis for JAX-specific hazards (buffer donation, "
             "PRNG key reuse, host syncs in hot loops, recompile hazards, "
-            "tracer leaks (docs/LINT.md)."
+            "tracer leaks) and concurrency hazards (guarded-by "
+            "discipline, lock-order cycles, blocking under locks) — "
+            "docs/LINT.md."
         ),
     )
     p.add_argument(
@@ -48,6 +54,13 @@ def parse_args(argv=None):
     )
     p.add_argument(
         "--list-rules", action="store_true", help="print the rule catalogue"
+    )
+    p.add_argument(
+        "--lock-graph",
+        action="store_true",
+        help="emit the static lock-acquisition graph over the given "
+        "paths as DOT (nodes = locks by declaration site, edges = "
+        "acquired-while-holding; R102 flags its cycles)",
     )
     return p.parse_args(argv)
 
@@ -77,13 +90,17 @@ def main(argv: Optional[list] = None) -> int:
     except FileNotFoundError as err:
         print(str(err), file=sys.stderr)
         return 2
-    findings = []
+    models = []
     for f in files:
         try:
-            findings.extend(lint_file(f, rules))
+            models.append(parse_model(f))
         except SyntaxError as err:
             print(f"jaxlint: cannot parse {f}: {err}", file=sys.stderr)
             return 2
+    if args.lock_graph:
+        print(build_lock_graph(models).to_dot())
+        return 0
+    findings = lint_models(models, rules)
     if args.json:
         print(render_json(findings, len(files)))
     else:

@@ -20,8 +20,14 @@ class Rule:
     id: str = ""
     name: str = ""
     description: str = ""
+    #: "module" rules see one file at a time; "project" rules (R102) see
+    #: every scanned module at once via ``check_project``.
+    scope: str = "module"
 
     def check(self, model: ModuleModel) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def check_project(self, models) -> Iterator[Finding]:
         raise NotImplementedError
 
     def finding(self, model: ModuleModel, node, message: str) -> Finding:
@@ -47,13 +53,33 @@ def register(cls):
 def run_rules(
     model: ModuleModel, rule_ids: Optional[Iterable[str]] = None
 ) -> list:
-    """All findings for one module, sorted by location."""
+    """All module-scope findings for one module, sorted by location."""
     ids = sorted(RULES) if rule_ids is None else list(rule_ids)
     findings = []
     for rid in ids:
         rule = RULES.get(rid)
         if rule is None:
             raise KeyError(f"unknown jaxlint rule: {rid}")
+        if rule.scope != "module":
+            continue
         findings.extend(rule.check(model))
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def run_project_rules(
+    models, rule_ids: Optional[Iterable[str]] = None
+) -> list:
+    """All project-scope findings over a set of modules (the cross-module
+    pass R102 needs: lock-order cycles only exist across files)."""
+    ids = sorted(RULES) if rule_ids is None else list(rule_ids)
+    findings = []
+    for rid in ids:
+        rule = RULES.get(rid)
+        if rule is None:
+            raise KeyError(f"unknown jaxlint rule: {rid}")
+        if rule.scope != "project":
+            continue
+        findings.extend(rule.check_project(models))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

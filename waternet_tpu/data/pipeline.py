@@ -75,16 +75,27 @@ class PipelineStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._stage_s: dict = {}
-        self._stage_n: dict = {}
-        self.pops = 0
-        self.stalls = 0
-        self.stall_s = 0.0
-        self._depth_sum = 0
-        self.depth_max = 0
-        self.workers = 0
-        self._transfer_bytes = 0
-        self._transfer_batches = 0
+        self._stage_s: dict = {}  # guarded-by: self._lock
+        self._stage_n: dict = {}  # guarded-by: self._lock
+        self.pops = 0  # guarded-by: self._lock
+        self.stalls = 0  # guarded-by: self._lock
+        self.stall_s = 0.0  # guarded-by: self._lock
+        self._depth_sum = 0  # guarded-by: self._lock
+        self.depth_max = 0  # guarded-by: self._lock
+        self.workers = 0  # guarded-by: self._lock
+        self._transfer_bytes = 0  # guarded-by: self._lock
+        self._transfer_batches = 0  # guarded-by: self._lock
+
+    def set_workers(self, n: int) -> None:
+        """Declare the worker count feeding this stats object. Locked
+        like every other mutator: pipelines are rebuilt per epoch around
+        a SHARED stats object, so the publish must not tear against a
+        draining worker's add_stage or a concurrent metrics() read (the
+        race threadlint R101 surfaced when ``workers`` gained its
+        guarded-by declaration — constructors used to assign the
+        attribute bare)."""
+        with self._lock:
+            self.workers = int(n)
 
     def add_stage(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -138,10 +149,12 @@ class PipelineStats:
 
     def metrics(self, prefix: str = "pipeline_") -> dict:
         """Flat float dict for epoch metrics / bench JSON lines."""
+        with self._lock:
+            workers = float(self.workers)
         out = {
             f"{prefix}stall_pct": round(self.stall_pct(), 2),
             f"{prefix}queue_depth": round(self.queue_depth_mean(), 2),
-            f"{prefix}workers": float(self.workers),
+            f"{prefix}workers": workers,
             f"{prefix}transfer_bytes_per_batch": round(
                 self.transfer_bytes_per_batch(), 1
             ),
@@ -182,7 +195,7 @@ class OrderedPipeline:
             else max(2 * self.workers, self.workers + 1)
         )
         self.stats = stats if stats is not None else PipelineStats()
-        self.stats.workers = self.workers
+        self.stats.set_workers(self.workers)
         self._fifo: deque = deque()
         self._closed = False
         self._pool = (
@@ -200,6 +213,7 @@ class OrderedPipeline:
                 item = next(self._items)
             except StopIteration:
                 break
+            # jaxlint: disable-next=R101 _fifo is consumer-thread-only: workers run fn(), never touch the FIFO
             self._fifo.append(self._pool.submit(self.fn, item))
 
     def __iter__(self) -> Iterator:
@@ -222,6 +236,7 @@ class OrderedPipeline:
         if not self._fifo:
             self.close()
             raise StopIteration
+        # jaxlint: disable-next=R101 _fifo is consumer-thread-only: workers run fn(), never touch the FIFO
         fut = self._fifo.popleft()
         stalled = not fut.done()
         t0 = time.perf_counter()
@@ -248,6 +263,7 @@ class OrderedPipeline:
         self._items = iter(())
         for fut in self._fifo:
             fut.cancel()
+        # jaxlint: disable-next=R101 _fifo is consumer-thread-only: workers run fn(), never touch the FIFO
         self._fifo.clear()
         if self._pool is not None:
             self._pool.shutdown(wait=True, cancel_futures=True)
@@ -285,7 +301,7 @@ class PrefetchIterator:
         self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
         self._stop = threading.Event()
         self.stats = stats if stats is not None else PipelineStats()
-        self.stats.workers = 1
+        self.stats.set_workers(1)
         self._finished = False
         self._thread = threading.Thread(
             target=self._run, name=f"{THREAD_PREFIX}-{name}", daemon=True

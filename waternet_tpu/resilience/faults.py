@@ -98,20 +98,20 @@ import threading
 from pathlib import Path
 from typing import NamedTuple
 
-_PLAN: "FaultPlan | None" = None
-_IMREAD_CALLS = 0
+_PLAN: "FaultPlan | None" = None  # guarded-by: _SERVE_LOCK (hot-path reads are lock-free `is None` checks by design)
+_IMREAD_CALLS = 0  # guarded-by: _IMREAD_LOCK
 _IMREAD_LOCK = threading.Lock()
-_LAUNCH_CALLS = 0
-_ADMIT_CALLS = 0
-_COMPLETE_CALLS = 0
-_STREAM_SESSIONS = 0
-_FRAME_DECODES = 0
+_LAUNCH_CALLS = 0  # guarded-by: _SERVE_LOCK
+_ADMIT_CALLS = 0  # guarded-by: _SERVE_LOCK
+_COMPLETE_CALLS = 0  # guarded-by: _SERVE_LOCK
+_STREAM_SESSIONS = 0  # guarded-by: _SERVE_LOCK
+_FRAME_DECODES = 0  # guarded-by: _SERVE_LOCK
 _SERVE_LOCK = threading.Lock()
 #: Release latch for armed ``replica_hang`` events: a wedged launch thread
 #: waits on this, and :func:`install` / :func:`clear` set it — so a test
 #: (or an operator fire drill) can un-wedge the "hung device" on cue and
 #: every thread stays joinable.
-_HANG_RELEASE = threading.Event()
+_HANG_RELEASE = threading.Event()  # guarded-by: _SERVE_LOCK (rebinding; the Event itself is thread-safe)
 
 
 class FaultPlan:

@@ -1,5 +1,10 @@
 """Elastic multi-process training: a local gang supervisor.
 
+Concurrency note (threadlint): this module is deliberately
+single-threaded — isolation comes from *processes* (``subprocess.Popen``
++ heartbeat files), so there are no locks and nothing to declare
+``guarded-by``. The supervisor loop owns all mutable state.
+
 ``waternet-launch`` (== ``python -m waternet_tpu.resilience.supervisor``)
 spawns N training worker processes — each running today's ``train.py``
 unchanged — and keeps the *job* alive across worker crash, hang, and

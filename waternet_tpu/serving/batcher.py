@@ -247,14 +247,14 @@ class DynamicBatcher:
                 tier="fast", supervision=self.supervision,
             )
         self._requests: queue.Queue = queue.Queue()
-        self._closed = False
+        self._closed = False  # guarded-by: self._submit_lock
         self.max_queue = int(max_queue)
         # Per-tier outstanding counts (submit lock): the quality tier's
         # backlog is the brown-out pressure gauge — past
         # ``downgrade_watermark``, opted-in quality requests route to the
         # fast tier instead of queueing (docs/SERVING.md "Fault
         # isolation").
-        self._tier_backlog = {t: 0 for t in self._pools}
+        self._tier_backlog = {t: 0 for t in self._pools}  # guarded-by: self._submit_lock
         # Outstanding-request count: submitted and not yet RESOLVED —
         # queued, coalescing, or in flight on a replica. This is the
         # admission-control gauge and the QueueFull bound: the
@@ -265,7 +265,7 @@ class DynamicBatcher:
         # finish it, and every such request holds host RAM until its
         # future resolves. Decremented by a future done-callback, which
         # covers every resolution path (result, error, deadline drop).
-        self._backlog = 0
+        self._backlog = 0  # guarded-by: self._submit_lock
         self.stats.queue_depth_probe = self.queue_depth
         self.stats.replica_health_probe = self.health
         # Makes the closed-check + enqueue atomic vs close(): without it a

@@ -589,10 +589,11 @@ class ReplicaPool:
         # a quarantine window, and two interleaved before/after cache
         # probes would mis-count compiles.
         self._fallback_lock = threading.Lock()
-        self._closed = False
-        self._watch: set = set()  # live _Inflight entries (watchdog scope)
-        self._old_threads: List[threading.Thread] = []
-        self.leaked_threads: List[str] = []
+        self._closed = False  # guarded-by: self._lock
+        # live _Inflight entries (watchdog scope)
+        self._watch: set = set()  # guarded-by: self._lock
+        self._old_threads: List[threading.Thread] = []  # guarded-by: self._lock
+        self.leaked_threads: List[str] = []  # guarded-by: self._lock
         self._probe_bucket = min(ladder, key=lambda b: b[0] * b[1])
         # A single replica keeps the engine's default placement (device
         # None) — byte-for-byte the PR-4 single-device behavior, and the
@@ -808,7 +809,7 @@ class ReplicaPool:
                 if not r.future.done():
                     r.future.set_exception(final)
 
-    def _retire_generation(self, replica: _Replica):
+    def _retire_generation(self, replica: _Replica):  # guarded-by: self._lock
         """Replace a replica's current worker generation (caller holds
         the pool lock): bump ``gen`` so a later-waking wedged thread
         knows to exit, spawn fresh threads on fresh queues, keep the old
@@ -956,17 +957,29 @@ class ReplicaPool:
                 ),
                 exclude=r,
             )
+        # The replica flag checks below run on a SNAPSHOT taken under
+        # the pool lock: worker threads flip ``state`` under the lock
+        # (crash -> SUSPECT in _on_batch_failure), and an unlocked scan
+        # could pair a fresh state with a stale ``_next_rewarm_at`` /
+        # ``_probe`` left over from the previous quarantine cycle. Every
+        # transition helper re-checks state under the lock before
+        # acting, so the snapshot is safe as well as consistent.
+        with self._lock:
+            scan = [
+                (r, r.state, r._next_rewarm_at, r._probe)
+                for r in self._replicas
+            ]
         # Promote suspects to quarantine (their failed batch already
         # re-dispatched in _on_batch_failure).
-        for r in self._replicas:
-            if r.state == SUSPECT:
+        for r, state, _, _ in scan:
+            if state == SUSPECT:
                 self._quarantine(r, reason="crash")
         # Re-warm due quarantined replicas; reintegrate finished probes.
-        for r in self._replicas:
-            if r.state == QUARANTINED and now >= r._next_rewarm_at:
+        for r, state, next_rewarm_at, probe in scan:
+            if state == QUARANTINED and now >= next_rewarm_at:
                 self._start_probe(r)
-            elif r.state == REWARMING and r._probe is not None and r._probe.done():
-                if r._probe.exception() is None:
+            elif state == REWARMING and probe is not None and probe.done():
+                if probe.exception() is None:
                     self._reintegrate(r)
                 else:
                     # The probe raised (launcher alive): back off and
@@ -1073,7 +1086,13 @@ class ReplicaPool:
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
         leaked = [t.name for t in threads if t.is_alive()]
-        self.leaked_threads = leaked
+        # Published under the lock: a concurrent close() (batcher close
+        # racing a test's finally) returns this list through the locked
+        # early-exit above, and an unlocked publish could hand it a torn
+        # view — the race threadlint R101 surfaced when leaked_threads
+        # gained its guarded-by declaration.
+        with self._lock:
+            self.leaked_threads = leaked
         if leaked:
             print(
                 f"ReplicaPool.close ({self.tier}): {len(leaked)} worker "

@@ -238,7 +238,7 @@ class ServingServer:
         self.draining = threading.Event()
         self._bound = threading.Event()
         self._drain_flag = False
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: self._inflight_lock
         self._inflight_lock = threading.Lock()
         self._reload_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None

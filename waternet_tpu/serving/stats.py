@@ -91,18 +91,19 @@ class ServingStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._latencies_s: List[float] = []  # bounded reservoir sample
-        self._reservoir_rng = random.Random(0)
-        self.requests = 0
-        self.batches = 0
-        self.real_slots = 0
-        self.total_slots = 0
-        self.real_px = 0
-        self.padded_px = 0
-        self.compiles = 0
-        self.fallback_native = 0
-        self.shed = 0
-        self.deadline_expired = 0
+        # bounded reservoir sample (algorithm R)
+        self._latencies_s: List[float] = []  # guarded-by: self._lock
+        self._reservoir_rng = random.Random(0)  # guarded-by: self._lock
+        self.requests = 0  # guarded-by: self._lock
+        self.batches = 0  # guarded-by: self._lock
+        self.real_slots = 0  # guarded-by: self._lock
+        self.total_slots = 0  # guarded-by: self._lock
+        self.real_px = 0  # guarded-by: self._lock
+        self.padded_px = 0  # guarded-by: self._lock
+        self.compiles = 0  # guarded-by: self._lock
+        self.fallback_native = 0  # guarded-by: self._lock
+        self.shed = 0  # guarded-by: self._lock
+        self.deadline_expired = 0  # guarded-by: self._lock
         #: Live queue-depth gauge: a zero-arg callable the owning batcher
         #: registers (DynamicBatcher.queue_depth). Left None, the summary
         #: reports 0 — stats objects riding an ExactShapeBatcher or a bare
@@ -113,33 +114,35 @@ class ServingStats:
         #: Left None, the summary reports {} — bare stats objects have no
         #: replica pool to report on.
         self.replica_health_probe = None
-        self.retried = 0
-        self.downgraded = 0
-        self.nan_outputs = 0
-        self.quarantines = 0
-        self.reintegrations = 0
-        self._recovery_max_s = 0.0
-        self._depth_sum = 0
-        self.depth_max = 0
-        self.replicas = 1
-        self._rep = {}  # index -> per-replica accumulator dict
+        self.retried = 0  # guarded-by: self._lock
+        self.downgraded = 0  # guarded-by: self._lock
+        self.nan_outputs = 0  # guarded-by: self._lock
+        self.quarantines = 0  # guarded-by: self._lock
+        self.reintegrations = 0  # guarded-by: self._lock
+        self._recovery_max_s = 0.0  # guarded-by: self._lock
+        self._depth_sum = 0  # guarded-by: self._lock
+        self.depth_max = 0  # guarded-by: self._lock
+        self.replicas = 1  # guarded-by: self._lock
+        # index -> per-replica accumulator dict
+        self._rep = {}  # guarded-by: self._lock
         # tier -> {requests, batches}: populated by declare_tier (each
         # ReplicaPool registers its tier at construction) and by records;
         # a bare stats object (ExactShapeBatcher, tests) grows its tier
         # rows on first traffic.
-        self._tiers = {}
-        self._t_first_batch = None
-        self._t_last_done = None
+        self._tiers = {}  # guarded-by: self._lock
+        self._t_first_batch = None  # guarded-by: self._lock
+        self._t_last_done = None  # guarded-by: self._lock
         # --- stream-session counters (POST /stream layer) ---
-        self.streams_opened = 0
-        self.streams_refused = 0
-        self.stream_frames_in = 0
-        self.stream_frames_delivered = 0
-        self.stream_frames_dropped = 0
-        self.stream_frames_out_of_budget = 0
-        self.stream_downgrades = 0
-        self._stream_lat_s: List[float] = []  # bounded reservoir sample
-        self._stream_rng = random.Random(1)
+        self.streams_opened = 0  # guarded-by: self._lock
+        self.streams_refused = 0  # guarded-by: self._lock
+        self.stream_frames_in = 0  # guarded-by: self._lock
+        self.stream_frames_delivered = 0  # guarded-by: self._lock
+        self.stream_frames_dropped = 0  # guarded-by: self._lock
+        self.stream_frames_out_of_budget = 0  # guarded-by: self._lock
+        self.stream_downgrades = 0  # guarded-by: self._lock
+        # bounded reservoir sample (algorithm R)
+        self._stream_lat_s: List[float] = []  # guarded-by: self._lock
+        self._stream_rng = random.Random(1)  # guarded-by: self._lock
         #: Live stream gauge: a zero-arg callable the owning StreamManager
         #: registers, returning {"active_streams": int,
         #: "per_session_p99_ms": {stream_id: p99}}. Left None, the summary

@@ -460,8 +460,8 @@ class StreamManager:
         self.encode = encode
         self.draining = draining
         self._lock = threading.Lock()
-        self._sessions: Dict[str, StreamSession] = {}
-        self._next_id = 0
+        self._sessions: Dict[str, StreamSession] = {}  # guarded-by: self._lock
+        self._next_id = 0  # guarded-by: self._lock
         stats.stream_probe = self._probe
 
     def active_count(self) -> int:
