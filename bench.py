@@ -385,6 +385,88 @@ def bench_serving(
     }
 
 
+def bench_obs(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+):
+    """Observability overhead A/B (docs/OBSERVABILITY.md "Overhead"):
+    the same mixed-resolution population as :func:`bench_serving` served
+    twice through ONE warmed batcher — tracing disarmed vs armed (ring
+    buffer recording, export disabled) — interleaved over several rounds
+    with best-of taken per arm to damp scheduler noise. The contract
+    line ``obs_overhead_pct`` is the throughput cost of leaving tracing
+    on in production; byte-identity of the two arms' outputs is asserted
+    inline (tracing must observe the pipeline, never perturb it).
+    """
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.obs import trace
+    from waternet_tpu.serving import DynamicBatcher, derive_buckets
+
+    n_images, max_batch, max_buckets = _serving_env_defaults(
+        n_images, max_batch, max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+    rounds = _env_int("WATERNET_BENCH_OBS_ROUNDS", 3)
+
+    params = _serving_params()
+    images, shapes = _serving_population(n_images, base)
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+
+    engine = InferenceEngine(params=params)
+    t0 = time.perf_counter()
+    batcher = DynamicBatcher(engine, ladder, max_batch=max_batch)
+    warmup_s = time.perf_counter() - t0
+
+    trace.disable()
+    trace.reset()
+    best_off = best_on = float("inf")
+    ref_outs = traced_outs = None
+    try:
+        # One untimed pass so neither arm pays first-execution costs
+        # (executor spin-up, allocator warmth) — the A/B measures
+        # tracing, not run order.
+        batcher.map_ordered(images)
+        for _ in range(rounds):
+            trace.disable()
+            t0 = time.perf_counter()
+            outs = batcher.map_ordered(images)
+            best_off = min(best_off, time.perf_counter() - t0)
+            if ref_outs is None:
+                ref_outs = outs
+            trace.reset()  # each traced round starts with an empty ring
+            trace.enable()
+            t0 = time.perf_counter()
+            traced_outs = batcher.map_ordered(images)
+            best_on = min(best_on, time.perf_counter() - t0)
+            trace.disable()
+        spans = trace.counters()
+    finally:
+        trace.disable()
+        trace.reset()
+        batcher.close()
+    identical = all(
+        np.array_equal(a, b) for a, b in zip(ref_outs, traced_outs)
+    )
+
+    off_ips = n_images / best_off
+    on_ips = n_images / best_on
+    overhead_pct = (off_ips - on_ips) / off_ips * 100.0
+    return {
+        "metric": "obs_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "percent",
+        "vs_baseline": None,
+        "tracing_off_images_per_sec": round(off_ips, 2),
+        "tracing_on_images_per_sec": round(on_ips, 2),
+        "spans_per_traced_run": spans["spans"],
+        "spans_evicted": spans["evicted"],
+        "byte_identical": bool(identical),
+        "rounds": rounds,
+        "warmup_sec": round(warmup_s, 1),
+        "n_images": n_images,
+        "max_batch": max_batch,
+    }
+
+
 def bench_serving_multi(
     n_images=None, max_batch=None, max_buckets=None, base_hw=None,
     replicas=None,
@@ -1740,7 +1822,7 @@ def main():
     parser.add_argument(
         "--config",
         choices=["train", "video", "serve", "serve_multi", "serve_http",
-                 "serve_chaos", "train_chaos", "tiers", "stream"],
+                 "serve_chaos", "train_chaos", "tiers", "stream", "obs"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
@@ -1759,9 +1841,12 @@ def main():
         "tiers (quality vs fast CAN-student A/B under per-request "
         "tier routing: throughput, FLOP ratio, SSIM-vs-teacher, int8 "
         "arm — docs/SERVING.md 'Quality tiers'), "
-        "or stream (N paced concurrent POST /stream sessions: sustained "
+        "stream (N paced concurrent POST /stream sessions: sustained "
         "fps/stream, p99 frame latency vs budget, drop/downgrade rate "
-        "at 2x real-time load — docs/SERVING.md 'Streaming')",
+        "at 2x real-time load — docs/SERVING.md 'Streaming'), "
+        "or obs (tracing overhead A/B: serving throughput with the "
+        "span recorder disarmed vs armed, byte-identity asserted — "
+        "docs/OBSERVABILITY.md 'Overhead')",
     )
     parser.add_argument(
         "--batch-size", type=int, default=4,
@@ -1781,6 +1866,7 @@ def main():
         "train_chaos": "chaos_train_images_per_sec",
         "tiers": "fast_tier_images_per_sec",
         "stream": "video_stream_fps",
+        "obs": "obs_overhead_pct",
     }.get(args.config, "uieb_train_images_per_sec_per_chip")
 
     def _fail(error: str, rc: int = 0):
@@ -1883,6 +1969,10 @@ def main():
 
     if args.config == "stream":
         print(json.dumps(bench_stream()))
+        return
+
+    if args.config == "obs":
+        print(json.dumps(bench_obs()))
         return
 
     # Two lines (see module docstring): the strict apples-to-apples host-fed

@@ -1,0 +1,648 @@
+"""Observability (waternet_tpu/obs/, docs/OBSERVABILITY.md): the ISSUE 14
+pins — the bounded ring recorder (capacity bound + eviction accounting,
+disabled-is-free), the Chrome trace-event export schema (Perfetto-ready),
+end-to-end request parentage through the serving stack under
+``replica_crash@K`` re-dispatch with byte-identity to an untraced healthy
+run, X-Request-Id accept/generate/echo on ``/enhance`` and ``/stream``,
+``GET /metrics`` cross-checked against ``/stats`` (one vocabulary),
+training spans riding the deferred-metrics loop with zero mid-epoch
+recompiles, the ``waternet-trace`` CLI (both modes), and the
+``bench.py --config obs`` contract line.
+
+The obs package spawns no threads of its own — the conftest thread-leak
+guard plus the module-wide lock-order watchdog below make that a tested
+property, not a comment.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from waternet_tpu.obs import trace
+from waternet_tpu.obs.cli import main as trace_cli
+from waternet_tpu.obs.prometheus import render_prometheus
+from waternet_tpu.obs.trace import TraceRecorder
+from waternet_tpu.resilience import faults
+from waternet_tpu.serving import BucketLadder, DynamicBatcher, SupervisionConfig
+from waternet_tpu.serving.server import ServingServer
+from waternet_tpu.serving.streams import FRAME_LEN, KIND_END, REC_HEAD
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# Lock-order watchdog module-wide: every test here runs with instrumented
+# locks, so a recording hook that introduced a new lock-order edge into
+# the serving core would fail the suite (docs/LINT.md "Concurrency rules").
+pytestmark = pytest.mark.usefixtures("locktrace")
+
+BUCKET = (32, 32)
+MAX_BATCH = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    """Every test starts and ends with the process-wide recorder disarmed
+    and empty — tracing state must never leak between tests."""
+    trace.disable()
+    trace.reset()
+    yield
+    trace.disable()
+    trace.reset()
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_fault_plan():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.models import WaterNet
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    return InferenceEngine(params=params)
+
+
+@pytest.fixture
+def server(engine):
+    """A running front door. Function-scoped so the conftest thread-leak
+    guard proves full shutdown after every single test."""
+    srv = ServingServer(
+        engine,
+        BucketLadder([BUCKET]),
+        max_batch=MAX_BATCH,
+        max_wait_ms=30,
+        replicas=1,
+        max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    yield srv
+    srv.request_drain()
+    assert srv.join() == 0
+
+
+def _request(port, method, path, body=None, headers=None, timeout=60.0):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+    finally:
+        conn.close()
+
+
+def _png(rgb):
+    import cv2
+
+    ok, buf = cv2.imencode(".png", rgb[:, :, ::-1])
+    assert ok
+    return buf.tobytes()
+
+
+def _images(rng, n=6):
+    """Mixed shapes in one 32x32 bucket class (same population shape as
+    the fault-isolation suite, so fault ordinals are easy to reason
+    about)."""
+    return [
+        np.asarray(rng.integers(0, 256, (24 + i, 26, 3)), dtype=np.uint8)
+        for i in range(n)
+    ]
+
+
+def _events_by_request(doc):
+    groups = {}
+    for ev in doc["traceEvents"]:
+        rid = (ev.get("args") or {}).get("request_id")
+        if rid is not None:
+            groups.setdefault(rid, []).append(ev)
+    return groups
+
+
+# ---------------------------------------------------------------------------
+# Recorder: ring bound, eviction accounting, disabled-is-free, export schema
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bound_and_eviction():
+    rec = TraceRecorder(capacity=8)
+    rec.enable()
+    t = time.perf_counter()
+    for i in range(20):
+        rec.record_span(f"s{i}", "test", t, t + 1e-6)
+    assert rec.counters() == {"spans": 8, "evicted": 12, "capacity": 8}
+    evs, _names = rec.snapshot()
+    # Oldest -> newest, and exactly the LAST capacity events survive.
+    assert [e[0] for e in evs] == [f"s{i}" for i in range(12, 20)]
+    rec.reset()
+    assert rec.counters() == {"spans": 0, "evicted": 0, "capacity": 8}
+    with pytest.raises(ValueError, match="capacity"):
+        TraceRecorder(capacity=0)
+
+
+def test_disabled_recording_is_a_noop():
+    rec = TraceRecorder(capacity=4)
+    t = time.perf_counter()
+    rec.record_span("s", "test", t, t + 1e-6)
+    rec.record_instant("i", "test")
+    with rec.span("ctx"):
+        pass
+    assert rec.counters()["spans"] == 0
+    # Arm/disarm edge: events recorded while enabled survive a disable.
+    rec.enable()
+    rec.record_span("kept", "test", t, t + 1e-6)
+    rec.disable()
+    rec.record_span("dropped", "test", t, t + 1e-6)
+    evs, _ = rec.snapshot()
+    assert [e[0] for e in evs] == ["kept"]
+
+
+def test_chrome_export_schema_pin(tmp_path):
+    """The on-disk document shape Perfetto opens: this is the schema the
+    CLI, docs, and external tooling depend on — pinned field by field."""
+    rec = TraceRecorder(capacity=16)
+    rec.enable()
+    t = time.perf_counter()
+    rec.record_span(
+        "device", "serving", t, t + 0.001, args={"request_id": "r1"}
+    )
+    rec.record_instant("redispatch", "serving", args={"request_id": "r1"})
+    doc = rec.export_chrome(tmp_path / "trace.json")
+    assert json.loads((tmp_path / "trace.json").read_text()) == doc
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"] == {"spans": 2, "evicted": 0, "capacity": 16}
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta and all(e["name"] == "thread_name" for e in meta)
+    x = next(e for e in evs if e["ph"] == "X")
+    assert {"name", "cat", "ph", "pid", "tid", "ts", "dur", "args"} <= set(x)
+    assert x["name"] == "device" and x["cat"] == "serving"
+    assert x["ts"] >= 0.0 and x["dur"] > 0.0  # rebased microseconds
+    i = next(e for e in evs if e["ph"] == "i")
+    assert i["s"] == "t"  # thread-scoped instant
+    assert i["args"]["request_id"] == "r1"
+
+
+# ---------------------------------------------------------------------------
+# Serving: parentage across re-dispatch, byte-identity tracing on/off
+# ---------------------------------------------------------------------------
+
+
+def test_trace_parentage_under_replica_crash(params, rng):
+    """replica_crash@K on a traced 2-replica pool: every request id walks
+    the full span chain (queue_wait -> coalesce -> replica_launch ->
+    device -> d2h -> serve), the poisoned batch's ids additionally carry
+    redispatch hop instants, and the outputs stay byte-identical to an
+    UNTRACED healthy 1-replica run — tracing observes the re-dispatch
+    story without perturbing a single byte."""
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    images = _images(rng)
+    ref_engine = InferenceEngine(params=params)
+    with DynamicBatcher(
+        ref_engine, BucketLadder([BUCKET]), max_batch=4, max_wait_ms=5
+    ) as b:
+        ref = b.map_ordered(images)  # tracing disarmed here
+
+    trace.enable()
+    engine = InferenceEngine(params=params)
+    b = DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=4, max_wait_ms=5,
+        replicas=2,
+        supervision=SupervisionConfig(
+            scan_interval_sec=0.005, rewarm_backoff_sec=0.01
+        ),
+    )
+    try:
+        faults.install(faults.FaultPlan.parse("replica_crash@1"))
+        futs = [
+            b.submit(im, request_id=f"obs-req-{i:03d}")
+            for i, im in enumerate(images)
+        ]
+        b.drain()
+        outs = [f.result() for f in futs]
+        faults.clear()
+    finally:
+        faults.clear()
+        b.close()
+        trace.disable()
+
+    for a, r in zip(outs, ref):
+        np.testing.assert_array_equal(a, r)
+    assert b.stats.summary()["retried"] >= 1  # the fault really fired
+
+    doc = trace.recorder().to_chrome()
+    groups = _events_by_request(doc)
+    chain = {"queue_wait", "coalesce", "replica_launch", "device", "d2h",
+             "serve"}
+    for i in range(len(images)):
+        rid = f"obs-req-{i:03d}"
+        kinds = {e["name"] for e in groups.get(rid, [])}
+        assert chain <= kinds, f"{rid}: missing {chain - kinds}"
+    hops = [
+        e for evs in groups.values() for e in evs
+        if e["ph"] == "i" and e["name"] == "redispatch"
+    ]
+    assert hops, "crash re-dispatch left no hop instants in the trace"
+    for h in hops:
+        assert h["args"]["request_id"].startswith("obs-req-")
+        assert h["args"]["error"]  # the exception class that evicted it
+    retried = {e["args"]["request_id"] for e in hops}
+    serve_retries = {
+        e["args"]["request_id"]: e["args"].get("retries", 0)
+        for evs in groups.values() for e in evs if e["name"] == "serve"
+    }
+    for rid in retried:
+        assert serve_retries[rid] >= 1  # hops and serve roots agree
+
+
+def test_tracing_is_byte_invisible(engine, rng):
+    """Same warmed batcher, same stream, tracing off then on: identical
+    bytes out, and the traced pass actually recorded spans."""
+    images = _images(rng, n=4)
+    with DynamicBatcher(
+        engine, BucketLadder([BUCKET]), max_batch=4, max_wait_ms=5
+    ) as b:
+        ref = b.map_ordered(images)
+        trace.enable()
+        traced = b.map_ordered(images)
+        trace.disable()
+    for a, r in zip(traced, ref):
+        np.testing.assert_array_equal(a, r)
+    assert trace.counters()["spans"] > 0
+    assert trace.counters()["evicted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Front door: X-Request-Id accept/generate/echo, /metrics vs /stats
+# ---------------------------------------------------------------------------
+
+
+def test_request_id_accept_generate_echo(server, rng):
+    port = server.bound_port
+    img = _png(np.asarray(rng.integers(0, 256, (24, 26, 3)), np.uint8))
+
+    # Client-supplied well-formed id: accepted and echoed verbatim.
+    status, hdrs, _ = _request(
+        port, "POST", "/enhance", body=img,
+        headers={"X-Request-Id": "abc-123.r/7:x"},
+    )
+    assert status == 200
+    assert hdrs["x-request-id"] == "abc-123.r/7:x"
+
+    # No id: the server generates one (16 hex chars) and echoes it.
+    status, hdrs, _ = _request(port, "POST", "/enhance", body=img)
+    assert status == 200
+    gen = hdrs["x-request-id"]
+    assert len(gen) == 16 and all(c in "0123456789abcdef" for c in gen)
+
+    # Malformed ids (over-long, or characters outside the token charset)
+    # are REPLACED, never reflected — header-injection hardening.
+    for bad in ("x" * 129, "bad id!"):
+        status, hdrs, _ = _request(
+            port, "POST", "/enhance", body=img,
+            headers={"X-Request-Id": bad},
+        )
+        assert status == 200
+        rid = hdrs["x-request-id"]
+        assert rid != bad and len(rid) == 16
+
+    # Error paths echo the id too — a failed request stays findable.
+    status, hdrs, _ = _request(
+        port, "POST", "/enhance", body=b"not a png",
+        headers={"X-Request-Id": "find-me-1"},
+    )
+    assert status == 400
+    assert hdrs["x-request-id"] == "find-me-1"
+
+
+def test_enhance_http_trace_chain(server, rng):
+    """One traced request through the real HTTP front door carries its id
+    from body decode to response write."""
+    port = server.bound_port
+    img = _png(np.asarray(rng.integers(0, 256, (24, 26, 3)), np.uint8))
+    trace.enable()
+    status, hdrs, _ = _request(
+        port, "POST", "/enhance", body=img,
+        headers={"X-Request-Id": "obs-http-0001"},
+    )
+    assert status == 200 and hdrs["x-request-id"] == "obs-http-0001"
+    # response_write is recorded just after the server drains the socket;
+    # give the handler a beat to get there after the client's read.
+    want = {"decode", "queue_wait", "coalesce", "replica_launch", "device",
+            "d2h", "serve", "response_write"}
+    deadline = time.monotonic() + 10.0
+    kinds = set()
+    while time.monotonic() < deadline:
+        doc = trace.recorder().to_chrome()
+        kinds = {
+            e["name"]
+            for e in _events_by_request(doc).get("obs-http-0001", [])
+        }
+        if want <= kinds:
+            break
+        time.sleep(0.01)
+    trace.disable()
+    assert want <= kinds, f"missing {want - kinds}"
+
+
+def _prom_value(text, name):
+    for line in text.splitlines():
+        if line.startswith(name) and (
+            line[len(name)] in (" ", "{")
+        ):
+            if line.startswith(name + " "):
+                return float(line.split()[-1])
+    raise AssertionError(f"no bare sample for {name} in /metrics")
+
+
+def test_metrics_matches_stats(server, rng):
+    """/metrics renders the SAME numbers /stats reports — one vocabulary,
+    two formats (docs/OBSERVABILITY.md '/metrics')."""
+    port = server.bound_port
+    img = _png(np.asarray(rng.integers(0, 256, (24, 26, 3)), np.uint8))
+    for _ in range(3):
+        status, _, _ = _request(port, "POST", "/enhance", body=img)
+        assert status == 200
+
+    status, hdrs, body = _request(port, "GET", "/stats")
+    assert status == 200
+    stats = json.loads(body)
+
+    status, hdrs, body = _request(port, "GET", "/metrics")
+    assert status == 200
+    assert hdrs["content-type"] == "text/plain; version=0.0.4; charset=utf-8"
+    text = body.decode()
+    assert text.endswith("\n")
+    assert "# HELP waternet_requests_total" in text
+    assert "# TYPE waternet_requests_total counter" in text
+
+    assert _prom_value(text, "waternet_requests_total") == stats["requests"]
+    assert _prom_value(text, "waternet_batches_total") == stats["batches"]
+    assert _prom_value(text, "waternet_replicas") == stats["replicas"]
+    assert _prom_value(text, "waternet_shed_total") == stats["shed_count"]
+    for q, p in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+        line = f'waternet_request_latency_ms{{quantile="{q}"}}'
+        assert any(
+            ln.startswith(line)
+            and float(ln.split()[-1]) == stats["latency_ms"][p]
+            for ln in text.splitlines()
+        ), line
+    # And the render is a pure function of the summary: same numbers when
+    # called directly on the server's stats object.
+    assert render_prometheus(server.stats.summary()).splitlines()[0] == \
+        text.splitlines()[0]
+
+
+# ---------------------------------------------------------------------------
+# Streams: session id on the response head, per-frame spans
+# ---------------------------------------------------------------------------
+
+
+def test_stream_request_id_and_frame_spans(engine, rng):
+    import socket
+
+    srv = ServingServer(
+        engine,
+        BucketLadder([BUCKET]),
+        max_batch=MAX_BATCH,
+        max_wait_ms=30,
+        replicas=1,
+        max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    trace.enable()
+    try:
+        sock = socket.create_connection(
+            ("127.0.0.1", srv.bound_port), timeout=60.0
+        )
+        head = (
+            "POST /stream HTTP/1.1\r\n"
+            f"Host: 127.0.0.1:{srv.bound_port}\r\n"
+            "X-Request-Id: obs-stream-7\r\n\r\n"
+        )
+        sock.sendall(head.encode("latin-1"))
+        f = sock.makefile("rb")
+        assert int(f.readline().split()[1]) == 200
+        hdrs = {}
+        while True:
+            line = f.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            k, _, v = line.decode("latin-1").partition(":")
+            hdrs[k.strip().lower()] = v.strip()
+        assert hdrs["x-request-id"] == "obs-stream-7"
+
+        frames = [
+            np.asarray(rng.integers(0, 256, (28, 30, 3)), np.uint8)
+            for _ in range(2)
+        ]
+        for rgb in frames:
+            payload = _png(rgb)
+            sock.sendall(FRAME_LEN.pack(len(payload)) + payload)
+        sock.sendall(FRAME_LEN.pack(0))  # END
+        while True:  # read records up to the Z summary
+            h = f.read(REC_HEAD.size)
+            if len(h) < REC_HEAD.size:
+                break
+            kind, _flags, _seq, n = REC_HEAD.unpack(h)
+            if n:
+                f.read(n)
+            if kind == KIND_END:
+                break
+        sock.close()
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+        trace.disable()
+
+    doc = trace.recorder().to_chrome()
+    frame_spans = [
+        e for e in doc["traceEvents"] if e["name"] == "stream_frame"
+    ]
+    rids = {e["args"]["request_id"] for e in frame_spans}
+    # Per-frame parentage: session id + "/" + frame seq.
+    assert {"obs-stream-7/0", "obs-stream-7/1"} <= rids
+    assert all(e["args"]["dropped"] is None for e in frame_spans)
+    sess = [e for e in doc["traceEvents"] if e["name"] == "stream_session"]
+    assert len(sess) == 1
+    assert sess[0]["args"]["request_id"] == "obs-stream-7"
+    assert sess[0]["args"]["delivered"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Training: spans ride the deferred-metrics loop, zero mid-epoch recompiles
+# ---------------------------------------------------------------------------
+
+
+def _batches(n, batch=8, hw=16, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        raw = rng.integers(0, 256, (batch, hw, hw, 3), dtype=np.uint8)
+        ref = rng.integers(0, 256, (batch, hw, hw, 3), dtype=np.uint8)
+        yield raw, ref
+
+
+def test_training_spans_zero_extra_fetches_no_recompile(compile_sentinel):
+    """Arming the tracer across a whole epoch adds spans for every step
+    dispatch and for each deferred metrics flush — and provably compiles
+    nothing (the spans ride clocks and D2H points the loop already had)."""
+    from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+    cfg = TrainConfig(
+        batch_size=8, im_height=16, im_width=16, precision="fp32",
+        perceptual_weight=0.0, augment=True, shuffle=False,
+    )
+    engine = TrainingEngine(cfg)
+    engine.train_epoch(_batches(1), epoch=0)  # warm-up, tracing disarmed
+    compile_sentinel.arm_engine(engine)
+    trace.enable()
+    engine.train_epoch(_batches(3, seed=1), epoch=1)
+    trace.disable()
+    compile_sentinel.check()  # tracing on => still zero recompiles
+
+    doc = trace.recorder().to_chrome()
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    dispatch = [e for e in spans if e["name"] == "step_dispatch"]
+    fetch = [e for e in spans if e["name"] == "metrics_fetch"]
+    assert len(dispatch) == 3
+    assert fetch, "deferred metrics flush recorded no fetch span"
+    # Every dispatched step is covered by exactly one fetch flush.
+    assert sum(e["args"]["steps"] for e in fetch) == 3
+
+
+# ---------------------------------------------------------------------------
+# CLI: trace analysis + supervisor timeline from existing artifacts
+# ---------------------------------------------------------------------------
+
+
+def _toy_trace(tmp_path):
+    rec = TraceRecorder(capacity=64)
+    rec.enable()
+    t = time.perf_counter()
+    for i, rid in enumerate(["r-slow", "r-fast"]):
+        rec.record_span("queue_wait", "serving", t, t + 0.002 + i * 0.01,
+                        args={"request_id": rid})
+        rec.record_span("device", "serving", t, t + 0.005,
+                        args={"request_id": rid})
+        rec.record_span("serve", "serving", t, t + 0.02,
+                        args={"request_id": rid, "retries": i})
+    rec.record_instant("redispatch", "serving",
+                       args={"request_id": "r-slow", "retry": 1,
+                             "error": "RuntimeError"})
+    path = tmp_path / "trace.json"
+    rec.export_chrome(path)
+    return path
+
+
+def test_cli_analyze_trace(tmp_path, capsys):
+    path = _toy_trace(tmp_path)
+    assert trace_cli([str(path), "--slowest", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "per-stage latency (ms):" in out
+    for stage in ("queue_wait", "device", "serve"):
+        assert stage in out
+    assert "critical path, slowest 2 of 2 requests:" in out
+    assert "request r-slow" in out
+    assert "1 re-dispatch hop(s)" in out
+    assert "span summary: 6 spans, 1 instants" in out
+    assert "of capacity 64" in out
+
+
+def test_cli_missing_trace_file(tmp_path, capsys):
+    assert trace_cli([str(tmp_path / "nope.json")]) == 1
+    assert "no such trace file" in capsys.readouterr().err
+
+
+def test_cli_train_timeline_from_artifacts(tmp_path, capsys):
+    """--train-root renders the supervisor story from artifacts PR 11
+    already writes — report + heartbeat files, zero new runtime writes —
+    and --export folds it into the same Chrome form as serving traces."""
+    (tmp_path / "supervisor-report.json").write_text(json.dumps({
+        "result": "recovered",
+        "restarts": 1,
+        "recovery_sec": [0.5],
+        "generations": [
+            {"generation": 0, "trigger": "crash", "duration_sec": 1.0,
+             "workers": [{"state": "dead", "exit_code": 1,
+                          "first_step": 0, "last_step": 3}]},
+            {"generation": 1, "trigger": None, "duration_sec": 2.0,
+             "workers": [{"state": "done", "exit_code": 0,
+                          "first_step": 3, "last_step": 7}]},
+        ],
+    }))
+    gen0 = tmp_path / "gen-000"
+    gen0.mkdir()
+    (gen0 / "worker-000.json").write_text(json.dumps({
+        "pid": 123, "process_id": 0, "generation": 0, "seq": 5,
+        "step": 3, "epoch": 0, "phase": "train", "time": 123.0,
+    }))
+    export = tmp_path / "timeline.json"
+    assert trace_cli(
+        ["--train-root", str(tmp_path), "--export", str(export)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "result=recovered restarts=1" in out
+    assert "generation 0: trigger=crash" in out
+    assert "generation 1: completed" in out
+    assert "starting -> running -> dead" in out
+    assert "starting -> running -> done" in out
+    assert "last beat: step 3, phase train, seq 5" in out
+    assert "recovery window 0: 0.5s" in out
+
+    doc = json.loads(export.read_text())
+    evs = doc["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}  # one pid per generation
+    gens = [e for e in evs if e["tid"] == 0]
+    workers = [e for e in evs if e["tid"] == 1]
+    assert len(gens) == 2 and len(workers) == 2
+    # Generations lay out sequentially on the timeline.
+    assert gens[1]["ts"] >= gens[0]["ts"] + gens[0]["dur"]
+
+
+def test_cli_train_timeline_empty_dir(tmp_path, capsys):
+    assert trace_cli(["--train-root", str(tmp_path)]) == 1
+    assert "no supervisor artifacts" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# bench --config obs: the overhead contract line
+# ---------------------------------------------------------------------------
+
+
+def test_bench_obs_contract_line(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("WATERNET_BENCH_OBS_ROUNDS", "1")
+    res = bench.bench_obs(n_images=8, max_batch=4, max_buckets=2, base_hw=28)
+    assert res["metric"] == "obs_overhead_pct"
+    assert res["unit"] == "percent"
+    assert isinstance(res["value"], float) and np.isfinite(res["value"])
+    assert res["byte_identical"] is True  # tracing never perturbs outputs
+    assert res["spans_per_traced_run"] > 0
+    assert res["spans_evicted"] == 0
+    assert res["tracing_off_images_per_sec"] > 0
+    assert res["tracing_on_images_per_sec"] > 0
+    # The bench leaves the process-wide recorder disarmed and empty.
+    assert not trace.enabled()
+    assert trace.counters()["spans"] == 0

@@ -956,7 +956,8 @@ def test_bench_serving_multi_scales_on_multicore():
      ("serve_chaos", "chaos_images_per_sec"),
      ("train_chaos", "chaos_train_images_per_sec"),
      ("tiers", "fast_tier_images_per_sec"),
-     ("stream", "video_stream_fps")],
+     ("stream", "video_stream_fps"),
+     ("obs", "obs_overhead_pct")],
 )
 def test_bench_serve_fail_line_keeps_own_metric(config, metric):
     """Unreachable hardware in the serve configs: rc 0 and the

@@ -1,0 +1,41 @@
+"""Unified observability: one trace through the stack (docs/OBSERVABILITY.md).
+
+PRs 4–12 built the serving/training/resilience moving parts, each with
+its own ad-hoc ``time.perf_counter()`` aggregates. This package gives
+them one shared instrument set:
+
+* :mod:`waternet_tpu.obs.trace` — a lock-light, bounded ring-buffer span
+  recorder (monotonic clocks) with Chrome trace-event JSON export
+  viewable in Perfetto. Serving threads spans per request (admission →
+  decode → queue wait → coalesce → replica launch → device compute →
+  D2H → response write, re-dispatch hops, stream frame lifecycles); the
+  trainer rides the deferred-metrics loop host-side, exactly like
+  heartbeats — zero extra device fetches.
+* :mod:`waternet_tpu.obs.prometheus` — Prometheus text-format rendering
+  of :meth:`waternet_tpu.serving.stats.ServingStats.summary`, served by
+  the front door as ``GET /metrics`` (one vocabulary with ``/stats``).
+* :mod:`waternet_tpu.obs.cli` — the ``waternet-trace`` console entry:
+  per-stage latency breakdowns, critical-path attribution for the
+  slowest requests, and supervisor timelines from heartbeat dirs.
+
+Tracing is OFF by default; when disabled every hook is a single
+attribute load + bool check (the ``obs_overhead_pct`` bench pins the
+armed cost at ≤ 2%). The recorder spawns no threads of its own.
+"""
+
+from waternet_tpu.obs.trace import (  # noqa: F401
+    DEFAULT_CAPACITY,
+    TraceRecorder,
+    counters,
+    disable,
+    enable,
+    enabled,
+    export,
+    new_request_id,
+    record_instant,
+    record_span,
+    recorder,
+    reset,
+    span,
+)
+from waternet_tpu.obs.prometheus import render_prometheus  # noqa: F401

@@ -1,0 +1,215 @@
+"""Prometheus text-format rendering of the serving stats summary.
+
+``GET /metrics`` on the front door is a pure projection of
+:meth:`waternet_tpu.serving.stats.ServingStats.summary` — the exact dict
+``GET /stats`` returns — into the Prometheus text exposition format
+(version 0.0.4). One vocabulary, two wire formats: every counter and
+gauge here is cross-checkable against the ``/stats`` JSON field it came
+from, and tests/test_obs.py pins that equivalence.
+
+Mapping conventions:
+
+* monotone counts (``requests``, ``shed_count``, stream frame counts,
+  per-tier/per-replica counts) become ``counter`` samples with the
+  conventional ``_total`` suffix;
+* instantaneous values (queue depth, occupancy, images/sec, recovery
+  max) become ``gauge`` samples;
+* quantile summaries (``latency_ms``, stream ``frame_latency_ms``)
+  become one sample per quantile with a ``quantile`` label, mirroring
+  the Prometheus summary type;
+* replica health is one ``waternet_replica_health`` sample per replica
+  with ``tier``/``replica``/``state`` labels and value 1 — the state is
+  a label so dashboards can group on it without a state→number codec.
+
+No external client library: the text format is a few lines of string
+assembly, and the repo's no-new-deps rule holds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def _fmt(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+def _escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _sample(name: str, labels: Optional[Dict[str, object]], value) -> str:
+    if labels:
+        body = ",".join(
+            f'{k}="{_escape(v)}"' for k, v in labels.items()
+        )
+        return f"{name}{{{body}}} {_fmt(value)}"
+    return f"{name} {_fmt(value)}"
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: List[str] = []
+
+    def metric(
+        self,
+        name: str,
+        mtype: str,
+        help_text: str,
+        samples: Iterable[Tuple[Optional[Dict[str, object]], object]],
+    ) -> None:
+        rows = [_sample(name, labels, value) for labels, value in samples]
+        if not rows:
+            return
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {mtype}")
+        self.lines.extend(rows)
+
+    def one(self, name, mtype, help_text, value, labels=None) -> None:
+        self.metric(name, mtype, help_text, [(labels, value)])
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(summary: dict) -> str:
+    """Render a ``ServingStats.summary()`` dict as Prometheus text."""
+    w = _Writer()
+
+    w.one("waternet_requests_total", "counter",
+          "Requests resolved by the batcher.", summary["requests"])
+    w.one("waternet_batches_total", "counter",
+          "Batched device launches.", summary["batches"])
+    w.metric(
+        "waternet_request_latency_ms", "gauge",
+        "End-to-end request latency quantiles (ms).",
+        [({"quantile": q}, summary["latency_ms"][p])
+         for q, p in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))],
+    )
+    w.one("waternet_batch_occupancy", "gauge",
+          "Mean filled fraction of launched batches.",
+          summary["batch_occupancy"])
+    w.one("waternet_padding_overhead", "gauge",
+          "Mean padded-pixels overhead of launched batches.",
+          summary["padding_overhead"])
+    w.one("waternet_compiles_total", "counter",
+          "Bucket executable compiles.", summary["compiles"])
+    w.one("waternet_fallback_native_shapes_total", "counter",
+          "Requests served at native shape outside the ladder.",
+          summary["fallback_native_shapes"])
+    w.one("waternet_shed_total", "counter",
+          "Requests shed by admission control.", summary["shed_count"])
+    w.one("waternet_deadline_expired_total", "counter",
+          "Requests dropped after their deadline expired.",
+          summary["deadline_expired"])
+    w.one("waternet_retried_total", "counter",
+          "Requests re-dispatched after a replica fault.",
+          summary["retried"])
+    w.one("waternet_downgraded_total", "counter",
+          "Requests served on a lower tier than requested.",
+          summary["downgraded"])
+    w.one("waternet_nan_outputs_total", "counter",
+          "Batches rejected by the output guard.",
+          summary["nan_outputs"])
+    w.one("waternet_quarantines_total", "counter",
+          "Replica quarantine transitions.", summary["quarantines"])
+    w.one("waternet_reintegrations_total", "counter",
+          "Replica reintegrations after re-warm.",
+          summary["reintegrations"])
+    w.one("waternet_recovery_sec_max", "gauge",
+          "Slowest observed quarantine→healthy recovery (s).",
+          summary["recovery_sec_max"])
+    w.one("waternet_queue_depth", "gauge",
+          "Current batcher queue depth.", summary["queue_depth"])
+    w.one("waternet_queue_depth_mean", "gauge",
+          "Mean queue depth sampled at admissions.",
+          summary["queue_depth_mean"])
+    w.one("waternet_queue_depth_max", "gauge",
+          "Max queue depth sampled at admissions.",
+          summary["queue_depth_max"])
+    w.one("waternet_replicas", "gauge",
+          "Configured replica count.", summary["replicas"])
+    w.one("waternet_images_per_sec", "gauge",
+          "Resolved-request throughput since stats start.",
+          summary["images_per_sec"])
+    w.one("waternet_load_imbalance", "gauge",
+          "Max/mean per-replica request ratio.",
+          summary["load_imbalance"])
+
+    w.metric(
+        "waternet_replica_health", "gauge",
+        "Replica health: one sample per replica, state as a label.",
+        [({"tier": tier, "replica": idx, "state": state}, 1)
+         for tier, reps in sorted(summary["replica_health"].items())
+         for idx, state in sorted(reps.items())],
+    )
+    w.metric(
+        "waternet_tier_requests_total", "counter",
+        "Requests resolved per tier.",
+        [({"tier": tier}, t["requests"])
+         for tier, t in sorted(summary["tiers"].items())],
+    )
+    w.metric(
+        "waternet_tier_batches_total", "counter",
+        "Batches launched per tier.",
+        [({"tier": tier}, t["batches"])
+         for tier, t in sorted(summary["tiers"].items())],
+    )
+
+    s = summary["streams"]
+    w.one("waternet_streams_opened_total", "counter",
+          "Stream sessions accepted.", s["opened"])
+    w.one("waternet_streams_refused_total", "counter",
+          "Stream sessions refused at admission.", s["refused"])
+    w.one("waternet_stream_frames_in_total", "counter",
+          "Frames read off stream sockets.", s["frames_in"])
+    w.one("waternet_stream_frames_delivered_total", "counter",
+          "Frames delivered downstream.", s["frames_delivered"])
+    w.one("waternet_stream_frames_dropped_total", "counter",
+          "Frames dropped by window enforcement.", s["frames_dropped"])
+    w.one("waternet_stream_frames_out_of_budget_total", "counter",
+          "Delivered frames that missed their latency budget.",
+          s["frames_out_of_budget"])
+    w.one("waternet_stream_downgrades_total", "counter",
+          "Stream frames served on a downgraded tier.", s["downgrades"])
+    w.one("waternet_active_streams", "gauge",
+          "Currently open stream sessions.", s["active_streams"])
+    w.metric(
+        "waternet_stream_session_p99_ms", "gauge",
+        "Per-session frame-latency p99 (ms).",
+        [({"stream": sid}, v)
+         for sid, v in sorted(s["per_session_p99_ms"].items())],
+    )
+    w.metric(
+        "waternet_stream_frame_latency_ms", "gauge",
+        "Stream frame latency quantiles (ms).",
+        [({"quantile": "0.5"}, s["frame_latency_ms"]["p50"]),
+         ({"quantile": "0.99"}, s["frame_latency_ms"]["p99"])],
+    )
+
+    per_replica = summary["per_replica"]
+    w.metric(
+        "waternet_replica_requests_total", "counter",
+        "Requests resolved per replica.",
+        [({"replica": r["replica"]}, r["requests"]) for r in per_replica],
+    )
+    w.metric(
+        "waternet_replica_batches_total", "counter",
+        "Batches launched per replica.",
+        [({"replica": r["replica"]}, r["batches"]) for r in per_replica],
+    )
+    w.metric(
+        "waternet_replica_busy_seconds_total", "counter",
+        "Cumulative device-busy wall time per replica (s).",
+        [({"replica": r["replica"]}, r["busy_sec"]) for r in per_replica],
+    )
+    return w.text()

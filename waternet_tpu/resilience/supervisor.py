@@ -56,6 +56,7 @@ import time
 from pathlib import Path
 from typing import Optional
 
+from waternet_tpu.obs import trace
 from waternet_tpu.parallel import distributed as dist
 from waternet_tpu.resilience import heartbeat as hb
 
@@ -235,6 +236,7 @@ class Supervisor:
         gen_dir = self.heartbeat_dir / f"gen-{generation:03d}"
         gen_dir.mkdir(parents=True, exist_ok=True)
         t0 = time.time()
+        t_gen0 = time.perf_counter()
         procs = self._spawn(generation, port, gen_dir)
         health = [
             hb.WorkerHealth(cfg.late_sec, cfg.hang_sec, cfg.startup_grace_sec, t0)
@@ -275,6 +277,16 @@ class Supervisor:
                     "workers": [w.summary() for w in health],
                 }
             )
+            # Fold the generation into the live trace timeline (in-proc
+            # supervisors, e.g. tests/bench; waternet-trace --train-root
+            # reconstructs the same view from artifacts after the fact).
+            if trace.enabled():
+                trace.record_span(
+                    "generation", "supervisor", t_gen0,
+                    time.perf_counter(),
+                    args={"generation": generation, "trigger": trigger,
+                          "workers": [w.state for w in health]},
+                )
         return trigger is None, trigger
 
     def run(self) -> dict:
@@ -300,6 +312,13 @@ class Supervisor:
                 f"restart {self.restarts}/{cfg.max_restarts} in {delay:.1f}s "
                 "(resuming from the latest complete checkpoint)"
             )
+            if trace.enabled():
+                trace.record_instant(
+                    "restart", "supervisor",
+                    args={"generation": generation, "trigger": trigger,
+                          "restart": self.restarts,
+                          "backoff_sec": delay},
+                )
             self._sleep(delay)
             generation += 1
 

@@ -43,6 +43,7 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.obs import trace
 from waternet_tpu.serving.bucketing import BucketLadder
 from waternet_tpu.serving.replicas import (
     ReplicaPool,
@@ -91,7 +92,7 @@ class DeadlineExpired(RuntimeError):
 
 class _Request:
     __slots__ = ("image", "future", "t_submit", "t_admit", "deadline",
-                 "tier", "retries", "allow_downgrade")
+                 "tier", "retries", "allow_downgrade", "req_id")
 
     def __init__(
         self,
@@ -99,9 +100,14 @@ class _Request:
         deadline: Optional[float] = None,
         tier: str = "quality",
         allow_downgrade: bool = False,
+        req_id: Optional[str] = None,
     ):
         self.image = image
         self.tier = tier
+        # Correlation id stamped on every span this request touches
+        # (docs/OBSERVABILITY.md); the front door echoes it in
+        # ``X-Request-Id``. None = uncorrelated (library callers).
+        self.req_id = req_id
         # Re-dispatch budget consumed by the replica pool when this
         # request's batch demonstrably fails (docs/SERVING.md "Fault
         # isolation"); ``allow_downgrade`` is the brown-out opt-in.
@@ -298,9 +304,15 @@ class DynamicBatcher:
         deadline: Optional[float] = None,
         tier: Optional[str] = None,
         allow_downgrade: bool = False,
+        request_id: Optional[str] = None,
     ) -> Future:
         """Queue one (H, W, 3) uint8 image; resolves to its enhanced
         native-shape uint8 array. Thread-safe.
+
+        ``request_id`` is an optional correlation id: when tracing is
+        armed (waternet_tpu/obs) every span this request touches —
+        queue wait, coalesce, device, re-dispatch hop — carries it, so a
+        failed loadgen request can be found in the server trace.
 
         ``deadline`` is an absolute ``time.perf_counter()`` instant.
         Already past at admission -> :class:`DeadlineExpired` here (the
@@ -363,7 +375,7 @@ class DynamicBatcher:
             )
         req = _Request(
             image, deadline=deadline, tier=tier,
-            allow_downgrade=allow_downgrade,
+            allow_downgrade=allow_downgrade, req_id=request_id,
         )
         # The callback reads the served tier off the FUTURE (set below,
         # before enqueue — resolution cannot precede dispatch), not off a
@@ -541,6 +553,13 @@ class DynamicBatcher:
 
     def _admit(self, req: _Request, pending: dict) -> None:
         req.t_admit = time.perf_counter()
+        if trace.enabled():
+            # Queue wait: submit -> dispatcher admission, from timestamps
+            # the batcher already keeps — arming adds no clock reads.
+            trace.record_span(
+                "queue_wait", "serving", req.t_submit, req.t_admit,
+                args={"request_id": req.req_id, "tier": req.tier},
+            )
         h, w = req.image.shape[:2]
         bucket = self.ladder.bucket_for(h, w)
         # Coalescing is per (tier, bucket): tiers never share a device
@@ -617,6 +636,21 @@ class DynamicBatcher:
                     )
             else:
                 live.append(r)
+        if trace.enabled():
+            # Coalesce: admission -> flush, per surviving request; the
+            # dropped ones get instants so a trace explains the gap.
+            for r in live:
+                trace.record_span(
+                    "coalesce", "serving", r.t_admit, now,
+                    args={"request_id": r.req_id, "tier": tier,
+                          "bucket": str(bucket)},
+                )
+            for r in reqs:
+                if r not in live and r.future.done():
+                    trace.record_instant(
+                        "request_dropped", "serving", t=now,
+                        args={"request_id": r.req_id, "tier": tier},
+                    )
         if not live:
             return
         try:

@@ -43,7 +43,14 @@ from typing import Dict, List, Optional
 from urllib.parse import urlparse
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.obs.trace import new_request_id
 from waternet_tpu.serving.stats import _percentile
+
+#: Cap on the per-request failure ledger in a report: enough to chase
+#: every id in a test run, bounded so a saturation run's report stays a
+#: report (the full counts are always exact; only the ledger truncates,
+#: and ``failures_truncated`` says by how much).
+MAX_FAILURE_RECORDS = 128
 
 
 def run_load(
@@ -68,9 +75,16 @@ def run_load(
     ``X-Tier-Allow-Downgrade: 1`` (the brown-out opt-in) and the report's
     ``downgraded`` counts 200s whose ``X-Tier-Served`` differs from the
     requested tier.
+
+    Every request carries a unique ``X-Request-Id`` (``lg-<run>-<i>``),
+    which the server echoes and stamps on its trace spans
+    (docs/OBSERVABILITY.md): the report's ``failures`` ledger lists each
+    non-ok request's id and outcome, so a shed/reset/error in a load run
+    is findable in the server-side trace by the same id.
     """
     u = urlparse(url)
     host, port = u.hostname, u.port or 80
+    run_tag = new_request_id()[:8]
     lock = threading.Lock()
     counts = {
         "ok": 0, "shed": 0, "deadline_expired": 0, "rejected": 0,
@@ -78,7 +92,16 @@ def run_load(
     }
     latencies: List[float] = []
     bodies: List = []
+    failures: List[Dict] = []
+    truncated = [0]
     indices = itertools.count()
+
+    def record_failure(rec: Dict) -> None:
+        # Caller holds `lock`.
+        if len(failures) < MAX_FAILURE_RECORDS:
+            failures.append(rec)
+        else:
+            truncated[0] += 1
 
     def worker():
         import http.client
@@ -91,7 +114,11 @@ def run_load(
                 if i >= total:
                     break
                 payload = payloads[i % len(payloads)]
-                headers = {"Content-Type": "application/octet-stream"}
+                rid = f"lg-{run_tag}-{i:05d}"
+                headers = {
+                    "Content-Type": "application/octet-stream",
+                    "X-Request-Id": rid,
+                }
                 if deadline_ms is not None:
                     headers["X-Deadline-Ms"] = str(deadline_ms)
                 if tier is not None:
@@ -123,6 +150,11 @@ def run_load(
                     )
                     with lock:
                         counts[key] += 1
+                        record_failure({
+                            "request_id": rid,
+                            "outcome": key,
+                            "error": type(err).__name__,
+                        })
                     conn.close()
                     conn = http.client.HTTPConnection(
                         host, port, timeout=timeout
@@ -138,12 +170,19 @@ def run_load(
                         # with X-Tier-Served: fast is not a downgrade.
                         if tier is not None and served and served != tier:
                             counts["downgraded"] += 1
-                    elif status == 429:
-                        counts["shed"] += 1
-                    elif status == 504:
-                        counts["deadline_expired"] += 1
                     else:
-                        counts["rejected"] += 1
+                        if status == 429:
+                            outcome = "shed"
+                        elif status == 504:
+                            outcome = "deadline_expired"
+                        else:
+                            outcome = "rejected"
+                        counts[outcome] += 1
+                        record_failure({
+                            "request_id": rid,
+                            "outcome": outcome,
+                            "status": status,
+                        })
                     if keep_bodies:
                         bodies.append((i, status, body))
                 if closed:
@@ -178,7 +217,11 @@ def run_load(
             "p50": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
             "p99": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
         },
+        "request_id_prefix": f"lg-{run_tag}",
+        "failures": failures,
     }
+    if truncated[0]:
+        report["failures_truncated"] = truncated[0]
     if keep_bodies:
         report["bodies"] = bodies
     return report
@@ -244,6 +287,7 @@ def run_stream_load(
 
     u = urlparse(url)
     host, port = u.hostname, u.port or 80
+    run_tag = new_request_id()[:8]
     lock = threading.Lock()
     counts = {
         "ok": 0, "dropped": 0, "out_of_budget": 0, "frame_errors": 0,
@@ -251,8 +295,15 @@ def run_stream_load(
     }
     totals = {"frames_sent": 0}
     latencies: List[float] = []
+    failures: List[Dict] = []
+
+    def record_failure(rec: Dict) -> None:
+        # Caller holds `lock`.
+        if len(failures) < MAX_FAILURE_RECORDS:
+            failures.append(rec)
 
     def stream_worker(si: int):
+        rid = f"lg-{run_tag}-s{si}"
         t_sent: Dict[int, float] = {}
         accounted = 0  # frames that got a record (or a refusal)
         sent = 0
@@ -263,6 +314,7 @@ def run_stream_load(
             head = (
                 "POST /stream HTTP/1.1\r\n"
                 f"Host: {host}:{port}\r\n"
+                f"X-Request-Id: {rid}\r\n"
                 f"X-Stream-Fps: {fps}\r\n"
             )
             if budget_ms is not None:
@@ -284,7 +336,13 @@ def run_stream_load(
                     break
             if status != 200:
                 with lock:
-                    counts["refused" if status == 503 else "errors"] += 1
+                    outcome = "refused" if status == 503 else "errors"
+                    counts[outcome] += 1
+                    record_failure({
+                        "request_id": rid,
+                        "outcome": outcome,
+                        "status": status,
+                    })
                 return
 
             done = threading.Event()
@@ -352,13 +410,19 @@ def run_stream_load(
             done.wait(timeout)
         except OSError as err:
             with lock:
-                counts[
+                key = (
                     "conn_reset"
                     if isinstance(
                         err, (ConnectionResetError, BrokenPipeError)
                     )
                     else "errors"
-                ] += 1
+                )
+                counts[key] += 1
+                record_failure({
+                    "request_id": rid,
+                    "outcome": key,
+                    "error": type(err).__name__,
+                })
         finally:
             if sock is not None:
                 try:
@@ -371,6 +435,11 @@ def run_stream_load(
                 # connection died under them. conn_reset, not silence.
                 if reset and sent > accounted:
                     counts["conn_reset"] += sent - accounted
+                    record_failure({
+                        "request_id": rid,
+                        "outcome": "conn_reset",
+                        "frames_unaccounted": sent - accounted,
+                    })
 
     threads = [
         threading.Thread(
@@ -404,6 +473,8 @@ def run_stream_load(
             "p50": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
             "p99": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
         },
+        "request_id_prefix": f"lg-{run_tag}",
+        "failures": failures,
     }
 
 

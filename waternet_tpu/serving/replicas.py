@@ -89,6 +89,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.obs import trace
 from waternet_tpu.resilience import faults
 from waternet_tpu.serving.bucketing import Bucket, BucketLadder
 from waternet_tpu.serving.stats import ServingStats
@@ -388,6 +389,23 @@ class _Replica:
                         tier=pool.tier,
                     )
                 entry.t0 = t0
+                if trace.enabled():
+                    # Replica launch: host preprocess + async dispatch.
+                    # The span closes here — the device itself is still
+                    # computing; its span closes at the completion
+                    # thread's existing D2H, never via a new sync.
+                    t_disp = time.perf_counter()
+                    for r in reqs:
+                        trace.record_span(
+                            "replica_launch", "serving", t0, t_disp,
+                            args={
+                                "request_id": getattr(r, "req_id", None),
+                                "replica": self.index,
+                                "tier": pool.tier,
+                                "bucket": f"{bucket[0]}x{bucket[1]}",
+                                "batch": len(reqs),
+                            },
+                        )
                 inflight_q.put((out, entry))
             except BaseException as err:
                 pool._on_batch_failure(entry, err, kind="crash")
@@ -459,6 +477,7 @@ class _Replica:
             if item is _CLOSE:
                 return
             out_dev, entry = item
+            t_d2h0 = time.perf_counter() if trace.enabled() else None
             try:
                 raw = np.asarray(out_dev)  # this replica's one D2H sync
             except BaseException as err:
@@ -499,6 +518,25 @@ class _Replica:
                 pool.stats.record_latency(
                     t_done - r.t_submit, replica=self.index, tier=pool.tier
                 )
+                if t_d2h0 is not None:
+                    # Device span closed at the existing D2H above (no
+                    # added sync); the serve span is the request's whole
+                    # submit -> result wall, the trace's per-request root.
+                    rid = getattr(r, "req_id", None)
+                    common = {"request_id": rid, "replica": self.index,
+                              "tier": pool.tier}
+                    if entry.t0 is not None:
+                        trace.record_span(
+                            "device", "serving", entry.t0, t_done,
+                            args=common,
+                        )
+                    trace.record_span(
+                        "d2h", "serving", t_d2h0, t_done, args=common,
+                    )
+                    trace.record_span(
+                        "serve", "serving", r.t_submit, t_done,
+                        args=dict(common, retries=getattr(r, "retries", 0)),
+                    )
             if entry.t0 is not None:
                 pool.stats.record_replica_busy(self.index, t_done - entry.t0)
 
@@ -803,6 +841,23 @@ class ReplicaPool:
                 replica.work.put((bucket, retryable, 0, False))
             if count_retry:
                 self.stats.record_retry(len(retryable))
+            if trace.enabled():
+                # Re-dispatch hop markers, outside the pool lock: a
+                # re-dispatched request's span chain shows the hop
+                # between its failed and its serving replica.
+                t_hop = time.perf_counter()
+                for r in retryable:
+                    trace.record_instant(
+                        "redispatch", "serving", t=t_hop,
+                        args={
+                            "request_id": getattr(r, "req_id", None),
+                            "retry": getattr(r, "retries", 0),
+                            "to_replica": replica.index,
+                            "tier": self.tier,
+                            "error": type(err).__name__
+                            if err is not None else None,
+                        },
+                    )
         except ReplicaUnavailable as unavailable:
             final = unavailable if err is None else err
             for r in retryable:

@@ -66,6 +66,7 @@ is whatever ``cv2.imdecode`` reads, which is exactly what ``cv2.imread``
 reads on the local path, so the CLI and the service stay behaviorally
 interchangeable via ``inference.py --serve-url``); ``POST /stream``
 (length-prefixed frame session); ``GET /healthz``; ``GET /stats``;
+``GET /metrics`` (the same stats in Prometheus text format);
 ``POST /admin/reload``.
 
 The HTTP layer is deliberately hand-rolled on ``asyncio.start_server``
@@ -88,6 +89,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
+from waternet_tpu.obs import trace
+from waternet_tpu.obs.prometheus import render_prometheus
 from waternet_tpu.resilience import faults
 from waternet_tpu.resilience.preemption import PreemptionGuard
 from waternet_tpu.serving.batcher import (
@@ -126,6 +129,21 @@ MAX_BODY_BYTES = 64 << 20
 class ReloadMismatch(RuntimeError):
     """Hot reload refused: the new weights do not fit the serving model
     (tree / shape / dtype diff in ``args[0]``). Nothing was swapped."""
+
+
+def _request_id(headers: dict) -> str:
+    """The request's correlation id: the client's ``X-Request-Id`` when
+    it is a sane header token, else a fresh one. The id is echoed back
+    verbatim in a response header, so anything that could smuggle CRLF
+    or grow unbounded is replaced, not escaped."""
+    raw = headers.get("x-request-id", "").strip()
+    if (
+        raw
+        and len(raw) <= 128
+        and all(c.isalnum() or c in "-_.:/" for c in raw)
+    ):
+        return raw
+    return trace.new_request_id()
 
 
 def _content_length(headers: dict) -> int:
@@ -513,6 +531,19 @@ class ServingServer:
                 self._json(writer, 200, self.stats.summary())
                 and not want_close
             )
+        if path == "/metrics":
+            # Prometheus text format, derived from the SAME summary dict
+            # /stats serves — one vocabulary, two wire formats
+            # (docs/OBSERVABILITY.md "/metrics").
+            return (
+                self._respond(
+                    writer,
+                    200,
+                    render_prometheus(self.stats.summary()).encode(),
+                    ctype="text/plain; version=0.0.4; charset=utf-8",
+                )
+                and not want_close
+            )
         if path in ("/enhance", "/v1/enhance"):
             if method != "POST":
                 return self._json(
@@ -577,15 +608,26 @@ class ServingServer:
     # -- /enhance ------------------------------------------------------
 
     async def _enhance(self, headers, body, writer) -> bool:
+        # X-Request-Id correlation (docs/OBSERVABILITY.md): accept the
+        # client's id or generate one, echo it on EVERY response, and
+        # stamp it on every span this request touches — a failed loadgen
+        # request can be found in the server trace by its id.
+        req_id = _request_id(headers)
+        rid = (("X-Request-Id", req_id),)
+
+        def jresp(status, payload, extra=(), close=False):
+            return self._json(
+                writer, status, payload, extra=tuple(extra) + rid,
+                close=close,
+            )
+
+        t_req0 = time.perf_counter() if trace.enabled() else None
         if self.draining.is_set():
             # Drain contract: late arrivals are refused AND the
             # connection closes, so pooled clients re-resolve elsewhere.
-            return self._json(
-                writer, 503, {"error": "draining"}, close=True
-            )
+            return jresp(503, {"error": "draining"}, close=True)
         if not self.ready.is_set():
-            return self._json(
-                writer,
+            return jresp(
                 503,
                 {"error": "warming up"},
                 extra=(("Retry-After", "1"),),
@@ -597,8 +639,7 @@ class ServingServer:
         # a tier is a quality contract, not a routing hint.
         tier = headers.get("x-tier", "quality").strip().lower()
         if tier not in ("quality", "fast"):
-            return self._json(
-                writer,
+            return jresp(
                 400,
                 {
                     "error": f"unknown tier {tier!r}",
@@ -606,8 +647,7 @@ class ServingServer:
                 },
             )
         if tier not in self.batcher.tiers:
-            return self._json(
-                writer,
+            return jresp(
                 400,
                 {
                     "error": "fast tier not configured on this server "
@@ -637,13 +677,10 @@ class ServingServer:
             try:
                 budget_ms = float(raw)
             except ValueError:
-                return self._json(
-                    writer, 400, {"error": f"bad X-Deadline-Ms {raw!r}"}
-                )
+                return jresp(400, {"error": f"bad X-Deadline-Ms {raw!r}"})
             if budget_ms <= 0 or budget_ms < self.min_deadline_ms:
                 self.stats.record_deadline_expired()
-                return self._json(
-                    writer,
+                return jresp(
                     504,
                     {
                         "error": "deadline cannot be met",
@@ -657,8 +694,7 @@ class ServingServer:
         # queue-depth watermark — both shed with 429 + Retry-After.
         if faults.admit_should_reject():
             self.stats.record_shed()
-            return self._json(
-                writer,
+            return jresp(
                 429,
                 {"error": "admission rejected (fault injection)"},
                 extra=(("Retry-After", "1"),),
@@ -678,8 +714,7 @@ class ServingServer:
             )
             if not will_downgrade:
                 self.stats.record_shed()
-                return self._json(
-                    writer,
+                return jresp(
                     429,
                     {"error": "overloaded", "queue_depth": depth},
                     extra=(("Retry-After", "1"),),
@@ -695,60 +730,74 @@ class ServingServer:
             rgb = await loop.run_in_executor(
                 None, _decode_request_image, body
             )
+            if t_req0 is not None:
+                trace.record_span(
+                    "decode", "serving", t_req0, time.perf_counter(),
+                    args={"request_id": req_id, "tier": tier,
+                          "bytes": len(body)},
+                )
             if rgb is None:
-                return self._json(
-                    writer, 400, {"error": "body is not a decodable image"}
+                return jresp(
+                    400, {"error": "body is not a decodable image"}
                 )
             try:
                 fut = self.batcher.submit(
                     rgb, deadline=deadline, tier=tier,
                     allow_downgrade=allow_downgrade,
+                    request_id=req_id,
                 )
             except UnknownTier as err:
-                return self._json(writer, 400, {"error": str(err)})
+                return jresp(400, {"error": str(err)})
             except QueueFull as err:
-                return self._json(
-                    writer,
+                return jresp(
                     429,
                     {"error": str(err)},
                     extra=(("Retry-After", "1"),),
                 )
             except DeadlineExpired as err:
-                return self._json(writer, 504, {"error": str(err)})
+                return jresp(504, {"error": str(err)})
             except RuntimeError:
                 # Batcher closed between the draining check and submit
                 # (drain finished while we decoded): a late arrival.
-                return self._json(
-                    writer, 503, {"error": "draining"}, close=True
-                )
+                return jresp(503, {"error": "draining"}, close=True)
             try:
                 out = await asyncio.wrap_future(fut)
             except DeadlineExpired as err:
-                return self._json(writer, 504, {"error": str(err)})
+                return jresp(504, {"error": str(err)})
             except ReplicaUnavailable as err:
                 # Every replica quarantined (healthz has been reporting
                 # unhealthy): tell clients to come back, not that the
                 # request was malformed.
-                return self._json(
-                    writer,
+                return jresp(
                     503,
                     {"error": str(err)},
                     extra=(("Retry-After", "1"),),
                 )
             except Exception as err:
-                return self._json(
-                    writer, 500, {"error": f"{type(err).__name__}: {err}"}
+                return jresp(
+                    500, {"error": f"{type(err).__name__}: {err}"}
                 )
+            t_enc0 = time.perf_counter() if trace.enabled() else None
             png = await loop.run_in_executor(None, _encode_response_png, out)
             keep = self._respond(
                 writer, 200, png, ctype="image/png",
-                extra=(("X-Tier-Served", getattr(fut, "tier", tier)),),
+                extra=(
+                    ("X-Tier-Served", getattr(fut, "tier", tier)),
+                ) + rid,
             )
             # Flush before the in-flight decrement: the drain poll must
             # not declare the server empty while this response is still
             # in the transport's user-space buffer — asyncio.run would
             # cancel the handler and truncate it on a slow client.
             await writer.drain()
+            if t_enc0 is not None:
+                trace.record_span(
+                    "response_write", "serving", t_enc0,
+                    time.perf_counter(),
+                    args={"request_id": req_id,
+                          "tier": getattr(fut, "tier", tier),
+                          "bytes": len(png)},
+                )
             return keep
         finally:
             with self._inflight_lock:
@@ -766,44 +815,50 @@ class ServingServer:
         established sessions keep their QoS. Admitted sessions get the
         ``application/x-waternet-stream`` response head and then run
         entirely inside the :class:`StreamManager`."""
+        # Session-level X-Request-Id, exactly as on /enhance: echoed on
+        # every refusal and on the stream head; frame spans derive
+        # per-frame ids as "<id>/<seq>" (docs/OBSERVABILITY.md).
+        req_id = _request_id(headers)
+        rid = (("X-Request-Id", req_id),)
+
+        def jresp(status, payload, extra=()):
+            self._json(
+                writer, status, payload, extra=tuple(extra) + rid,
+                close=True,
+            )
+
         if self.draining.is_set():
-            self._json(writer, 503, {"error": "draining"}, close=True)
+            jresp(503, {"error": "draining"})
             return
         if not self.ready.is_set():
-            self._json(
-                writer,
+            jresp(
                 503,
                 {"error": "warming up"},
                 extra=(("Retry-After", "1"),),
-                close=True,
             )
             return
         try:
             cfg = StreamConfig.from_headers(headers, self.stream_window)
         except ValueError as err:
-            self._json(writer, 400, {"error": str(err)}, close=True)
+            jresp(400, {"error": str(err)})
             return
         if cfg.tier not in ("quality", "fast"):
-            self._json(
-                writer,
+            jresp(
                 400,
                 {
                     "error": f"unknown tier {cfg.tier!r}",
                     "tiers": list(self.batcher.tiers),
                 },
-                close=True,
             )
             return
         if cfg.tier not in self.batcher.tiers:
-            self._json(
-                writer,
+            jresp(
                 400,
                 {
                     "error": "fast tier not configured on this server "
                     "(start waternet-serve with --student-weights)",
                     "tiers": list(self.batcher.tiers),
                 },
-                close=True,
             )
             return
         refusal = self.streams.refusal()
@@ -812,12 +867,10 @@ class ServingServer:
             # established ones. 503 (not 429): the service is telling
             # orchestrators to place the stream elsewhere for a while.
             self.stats.record_stream_refused()
-            self._json(
-                writer,
+            jresp(
                 503,
                 {"error": refusal},
                 extra=(("Retry-After", "1"),),
-                close=True,
             )
             return
         # In-flight for the drain poll, like /enhance: the batcher must
@@ -828,12 +881,13 @@ class ServingServer:
             head = (
                 "HTTP/1.1 200 OK\r\n"
                 "Content-Type: application/x-waternet-stream\r\n"
+                f"X-Request-Id: {req_id}\r\n"
                 "Connection: close\r\n"
                 "\r\n"
             )
             writer.write(head.encode("latin-1"))
             await writer.drain()
-            await self.streams.handle(cfg, reader, writer)
+            await self.streams.handle(cfg, reader, writer, request_id=req_id)
         except (ConnectionError, asyncio.IncompleteReadError):
             pass  # client went away; the session already cleaned up
         finally:

@@ -65,6 +65,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from waternet_tpu.obs import trace
 from waternet_tpu.resilience import faults
 from waternet_tpu.serving.batcher import (
     DeadlineExpired,
@@ -163,12 +164,15 @@ class StreamSession:
     reader task and a writer task (see the module docstring for the
     policies; the manager owns admission and the registry)."""
 
-    def __init__(self, sid, mgr, cfg, reader, writer):
+    def __init__(self, sid, mgr, cfg, reader, writer, request_id=None):
         self.sid = sid
         self.mgr = mgr
         self.cfg = cfg
         self.reader = reader
         self.writer = writer
+        # Correlation id for the whole session (the X-Request-Id the
+        # front door echoed); per-frame spans use "<id>/<seq>".
+        self.req_id = request_id or sid
         self.entries: deque = deque()
         self.progress = asyncio.Event()  # writer wake: new entry/state
         self.space = asyncio.Event()  # reader wake: room under hard cap
@@ -252,6 +256,7 @@ class StreamSession:
                             deadline=deadline,
                             tier=self.cfg.tier,
                             allow_downgrade=self.cfg.allow_downgrade,
+                            request_id=f"{self.req_id}/{entry.seq}",
                         )
                     except QueueFull:
                         entry.dropped = "queue"
@@ -309,6 +314,25 @@ class StreamSession:
         self.writer.write(payload)
         await self.writer.drain()
 
+    def _trace_frame(self, entry: _Frame, downgraded: bool = False) -> None:
+        """Frame lifecycle span (docs/OBSERVABILITY.md): socket read ->
+        terminal record written, with the drop/downgrade annotation
+        inline — a Perfetto view of a stream shows which frames paid
+        what, and why the gaps are gaps."""
+        if not trace.enabled():
+            return
+        trace.record_span(
+            "stream_frame", "serving", entry.t_read, time.perf_counter(),
+            args={
+                "request_id": f"{self.req_id}/{entry.seq}",
+                "stream": self.sid,
+                "seq": entry.seq,
+                "dropped": entry.dropped,
+                "downgraded": downgraded,
+                "error": entry.error,
+            },
+        )
+
     async def _deliver(self, entry: _Frame) -> None:
         loop = asyncio.get_running_loop()
         if entry.dropped is None and entry.error is None:
@@ -330,6 +354,7 @@ class StreamSession:
                 KIND_ERROR, 0, entry.seq,
                 json.dumps({"error": entry.error}).encode(),
             )
+            self._trace_frame(entry)
             return
         if entry.dropped is not None:
             self.mgr.stats.record_stream_drop(entry.dropped)
@@ -341,6 +366,7 @@ class StreamSession:
                 KIND_DROP, 0, entry.seq,
                 json.dumps({"reason": entry.dropped}).encode(),
             )
+            self._trace_frame(entry)
             return
         served = getattr(entry.future, "tier", self.cfg.tier)
         flags = 0
@@ -356,6 +382,7 @@ class StreamSession:
         if len(self.lat_s) > LATENCY_RESERVOIR:
             del self.lat_s[0]
         self.mgr.stats.record_stream_frame_delivered(span)
+        self._trace_frame(entry, downgraded=bool(flags & FLAG_DOWNGRADED))
 
     async def run_writer(self) -> None:
         try:
@@ -490,17 +517,29 @@ class StreamManager:
             return "pool saturated (queue at admission watermark)"
         return None
 
-    async def handle(self, cfg: StreamConfig, reader, writer) -> None:
+    async def handle(
+        self, cfg: StreamConfig, reader, writer, request_id=None
+    ) -> None:
         """Run one admitted session to completion (the front door has
         already validated tier/headers and written the response head)."""
         with self._lock:
             self._next_id += 1
             sid = f"s{self._next_id}"
-            session = StreamSession(sid, self, cfg, reader, writer)
+            session = StreamSession(
+                sid, self, cfg, reader, writer, request_id=request_id
+            )
             self._sessions[sid] = session
         self.stats.record_stream_open()
+        t_open = time.perf_counter() if trace.enabled() else None
         try:
             await session.run()
         finally:
             with self._lock:
                 self._sessions.pop(sid, None)
+            if t_open is not None:
+                trace.record_span(
+                    "stream_session", "serving", t_open,
+                    time.perf_counter(),
+                    args=dict(session.summary(),
+                              request_id=session.req_id),
+                )

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from typing import Any, Optional
 
 import jax
@@ -41,6 +42,7 @@ from waternet_tpu.data.augment import (
 )
 from waternet_tpu.models import WaterNet
 from waternet_tpu.models.vgg import VGG19Features
+from waternet_tpu.obs import trace
 from waternet_tpu.ops.fused import fused_train_preprocess
 from waternet_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -1211,7 +1213,21 @@ class TrainingEngine:
             """
             nonlocal pending, snapshot
             while pending:
+                # The ONE device fetch of the deferred-metrics loop: the
+                # `device` span for every step in the window closes HERE
+                # — tracing adds timestamps around the fetch that was
+                # already happening, never a new sync
+                # (docs/OBSERVABILITY.md "Training spans").
+                t_fetch0 = time.perf_counter() if trace.enabled() else None
                 vals = [_floats(m) for _, _, m in pending]
+                if t_fetch0 is not None:
+                    trace.record_span(
+                        "metrics_fetch", "training", t_fetch0,
+                        time.perf_counter(),
+                        args={"steps": len(pending),
+                              "first": pending[0][0],
+                              "last": pending[-1][0]},
+                    )
                 bad = sentinel.first_bad(vals) if sentinel is not None else None
                 if bad is None:
                     fetched.extend(vals)
@@ -1227,7 +1243,18 @@ class TrainingEngine:
                 snapshot = self._host_state_copy()
 
         for count, payload in payloads:
+            # Per-step host span, riding the loop exactly like the
+            # heartbeat below: dispatch is asynchronous, so this times
+            # the HOST's dispatch work (index/augment/enqueue) and
+            # fetches nothing; device time lands on the verify() fetch.
+            t_step0 = time.perf_counter() if trace.enabled() else None
             pending.append((count, payload, dispatch(count, payload)))
+            if t_step0 is not None:
+                trace.record_span(
+                    "step_dispatch", "training", t_step0,
+                    time.perf_counter(),
+                    args={"batch": count, "step": self._host_step},
+                )
             if control is None:
                 continue
             if control.heartbeat is not None:
