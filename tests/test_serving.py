@@ -398,15 +398,26 @@ def test_stats_schema_and_latency_percentiles():
     assert summary["batch_occupancy"] == pytest.approx(0.75)
     assert summary["padding_overhead"] == pytest.approx(0.25)
     assert set(summary) == {
-        "requests", "batches", "latency_ms", "batch_occupancy",
+        "requests", "batches", "latency_ms", "latency_ms_window",
+        "batch_occupancy",
         "padding_overhead", "compiles", "fallback_native_shapes",
         "shed_count", "deadline_expired", "retried", "downgraded",
         "nan_outputs", "quarantines", "reintegrations",
         "recovery_sec_max", "replica_health", "queue_depth",
         "queue_depth_mean", "queue_depth_max", "replicas",
         "images_per_sec", "load_imbalance", "tiers", "streams",
-        "per_replica",
+        "per_replica", "window", "slo",
     }
+    # Sliding-window restatement (docs/OBSERVABILITY.md "Windows &
+    # SLOs"): just-recorded latencies are in the 60 s window, quantiles
+    # within the histogram's ~6% relative error of the exact reservoir
+    # figures; SLO is None until a server is started with --slo.
+    assert summary["latency_ms_window"]["count"] == 3
+    assert summary["latency_ms_window"]["p99"] == pytest.approx(
+        100.0, rel=0.07
+    )
+    assert summary["window"]["window_sec"] == pytest.approx(60.0)
+    assert summary["slo"] is None
     # Stream counters (docs/SERVING.md "Streaming"): present with zeros
     # on a server that never opened a session, live gauges default-safe.
     assert set(summary["streams"]) == {

@@ -41,6 +41,23 @@ def test_train_cli_artifact_contract(run_dir):
     assert header.split(",")[:2] == ["mse", "ssim"]
 
 
+def test_train_cli_perf_csv_columns(run_dir):
+    """--perf-csv appends the windowed perf columns (docs/TRAINING.md):
+    same row count, two extra columns after the metric names, NaN where
+    the backend cannot measure (CPU: no MFU peak, no memory_stats).
+    Default-off keeps the byte-exact legacy header — pinned above."""
+    import train as cli
+
+    cli.main(ARGS + ["--epochs", "2", "--perf-csv"])
+    header = (run_dir / "metrics-train.csv").read_text().splitlines()[0]
+    assert header.split(",")[-2:] == ["mfu_live", "hbm_peak_bytes"]
+    train_csv = np.loadtxt(
+        run_dir / "metrics-train.csv", delimiter=",", skiprows=1
+    )
+    assert train_csv.shape == (2, len(header.split(",")))
+    assert np.isnan(train_csv[:, -2:]).all()
+
+
 def test_train_cli_epochs_zero_exits_cleanly(run_dir):
     import train as cli
 

@@ -1,0 +1,247 @@
+"""Bench-round trajectory: aggregate BENCH_r*/MULTICHIP_r* into one table.
+
+The hardware loop (tools/tpu_session.py) commits one ``BENCH_rNN.json``
+and one ``MULTICHIP_rNN.json`` per round; each is a point-in-time
+snapshot, and nobody reads five of them side by side. This tool does:
+
+    python tools/bench_history.py [--root .] [--threshold-pct 10]
+
+* loads every round in round order;
+* rounds whose contract line carries an ``error`` (tunnel down, chip
+  unreachable) are shown as ``stale`` — their numbers, if any, come
+  from the embedded ``last_measured_on_hardware`` block and are
+  EXCLUDED from regression math (a dead tunnel is not a perf change);
+* prints a per-metric trajectory across rounds with a direction-aware
+  delta between the two most recent healthy rounds;
+* flags any metric that moved beyond ``--threshold-pct`` in its bad
+  direction and exits 1 (CI-able: the hardware loop can gate on it);
+* multichip rounds contribute an ok/skipped/rc health row — a round
+  that stopped passing is a regression too.
+
+Direction heuristic: throughput-ish names (``per_sec``, ``mfu``,
+``vs_baseline``, ``reduction``, ``occupancy``) are higher-better;
+cost-ish suffixes (``_ms``, ``_pct``, ``_sec``, ``_bytes``) are
+lower-better; anything else is informational (never flagged).
+
+Pure stdlib, no jax — runnable on any host that has the checkouts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+#: Metric-name fragments that mean "bigger is better".
+_HIGHER = ("per_sec", "mfu", "vs_baseline", "reduction", "occupancy",
+           "images_per")
+#: Name suffixes that mean "smaller is better".
+_LOWER = ("_ms", "_pct", "_sec", "_bytes", "_overhead")
+
+
+def metric_direction(name: str) -> Optional[int]:
+    """+1 higher-better, -1 lower-better, None informational."""
+    if name == "value":
+        # The contract line's headline figure is images/sec/chip.
+        return 1
+    if any(frag in name for frag in _HIGHER):
+        return 1
+    if name.endswith(_LOWER):
+        return -1
+    return None
+
+
+def load_rounds(root: Path, stem: str) -> List[Tuple[int, dict]]:
+    """``(round, doc)`` pairs for ``<stem>_rNN.json``, round-ordered."""
+    out = []
+    for path in root.glob(f"{stem}_r*.json"):
+        m = _ROUND_RE.search(path.name)
+        if not m:
+            continue
+        try:
+            out.append((int(m.group(1)), json.loads(path.read_text())))
+        except (OSError, ValueError) as e:
+            print(f"bench_history: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+    return sorted(out, key=lambda t: t[0])
+
+
+def bench_round_values(doc: dict) -> Tuple[Dict[str, float], bool]:
+    """(numeric metrics, stale) for one BENCH round. Error rounds fall
+    back to their embedded last-measured-on-hardware block, marked
+    stale; a round with neither contributes nothing."""
+    parsed = doc.get("parsed") or {}
+    stale = bool(parsed.get("error")) or doc.get("rc", 0) != 0
+    source = parsed
+    if stale:
+        source = parsed.get("last_measured_on_hardware") or {}
+    vals = {
+        k: float(v)
+        for k, v in source.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+    return vals, stale
+
+
+def build_series(
+    rounds: List[Tuple[int, dict]],
+) -> Tuple[Dict[str, Dict[int, float]], Dict[int, bool]]:
+    """Per-metric {round: value} plus the per-round staleness map."""
+    series: Dict[str, Dict[int, float]] = {}
+    stale_by_round: Dict[int, bool] = {}
+    for rnd, doc in rounds:
+        vals, stale = bench_round_values(doc)
+        stale_by_round[rnd] = stale
+        for k, v in vals.items():
+            series.setdefault(k, {})[rnd] = v
+    return series, stale_by_round
+
+
+def find_regressions(
+    series: Dict[str, Dict[int, float]],
+    stale_by_round: Dict[int, bool],
+    threshold_pct: float,
+) -> List[dict]:
+    """Direction-aware latest-vs-previous deltas over HEALTHY rounds
+    only; entries beyond the threshold in the bad direction."""
+    flags = []
+    for name in sorted(series):
+        direction = metric_direction(name)
+        if direction is None:
+            continue
+        healthy = [
+            (rnd, v) for rnd, v in sorted(series[name].items())
+            if not stale_by_round.get(rnd, True)
+        ]
+        if len(healthy) < 2:
+            continue
+        (prev_rnd, prev), (last_rnd, last) = healthy[-2], healthy[-1]
+        if prev == 0:
+            continue
+        change_pct = (last - prev) / abs(prev) * 100.0
+        if direction * change_pct < -threshold_pct:
+            flags.append({
+                "metric": name,
+                "from_round": prev_rnd,
+                "to_round": last_rnd,
+                "from": prev,
+                "to": last,
+                "change_pct": round(change_pct, 2),
+            })
+    return flags
+
+
+def multichip_regression(
+    rounds: List[Tuple[int, dict]],
+) -> Optional[dict]:
+    """The latest multichip round failing where the previous passed."""
+    usable = [
+        (rnd, doc) for rnd, doc in rounds if not doc.get("skipped")
+    ]
+    if len(usable) < 2:
+        return None
+    (prev_rnd, prev), (last_rnd, last) = usable[-2], usable[-1]
+    if prev.get("ok") and not last.get("ok"):
+        return {
+            "metric": "multichip_ok",
+            "from_round": prev_rnd,
+            "to_round": last_rnd,
+            "from": True,
+            "to": False,
+            "change_pct": None,
+        }
+    return None
+
+
+def _fmt_val(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if abs(v) >= 1e6:
+        return f"{v:.3g}"
+    return f"{v:g}"
+
+
+def print_table(
+    series: Dict[str, Dict[int, float]],
+    stale_by_round: Dict[int, bool],
+    out,
+) -> None:
+    rnds = sorted(stale_by_round)
+    if not rnds:
+        return
+    header = ["r%02d%s" % (r, "*" if stale_by_round[r] else "")
+              for r in rnds]
+    name_w = max([len(n) for n in series] + [6])
+    print(f"{'metric':<{name_w}} " +
+          " ".join(f"{h:>10}" for h in header), file=out)
+    for name in sorted(series):
+        row = [
+            _fmt_val(series[name].get(r)) for r in rnds
+        ]
+        print(f"{name:<{name_w}} " +
+              " ".join(f"{c:>10}" for c in row), file=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Aggregate committed bench rounds into a trajectory "
+        "table and flag regressions.",
+    )
+    p.add_argument("--root", default=".",
+                   help="directory holding BENCH_r*/MULTICHIP_r* files")
+    p.add_argument("--threshold-pct", type=float, default=10.0,
+                   help="flag a metric moving this far in its bad "
+                        "direction between the last two healthy rounds")
+    args = p.parse_args(argv)
+    root = Path(args.root)
+
+    bench_rounds = load_rounds(root, "BENCH")
+    multi_rounds = load_rounds(root, "MULTICHIP")
+    if not bench_rounds and not multi_rounds:
+        print(f"bench_history: no BENCH_r*/MULTICHIP_r* files under {root}",
+              file=sys.stderr)
+        return 2
+
+    out = sys.stdout
+    series, stale_by_round = build_series(bench_rounds)
+    if series:
+        stale_n = sum(1 for s in stale_by_round.values() if s)
+        print(f"bench trajectory: {len(bench_rounds)} rounds "
+              f"({stale_n} stale — '*' columns reuse "
+              "last_measured_on_hardware)", file=out)
+        print_table(series, stale_by_round, out)
+    if multi_rounds:
+        print("multichip rounds:", file=out)
+        for rnd, doc in multi_rounds:
+            status = ("skipped" if doc.get("skipped")
+                      else "ok" if doc.get("ok") else "FAIL")
+            print(f"  r{rnd:02d}: {status} "
+                  f"(n_devices={doc.get('n_devices', '?')}, "
+                  f"rc={doc.get('rc', '?')})", file=out)
+
+    flags = find_regressions(series, stale_by_round, args.threshold_pct)
+    mc = multichip_regression(multi_rounds)
+    if mc is not None:
+        flags.append(mc)
+    if flags:
+        print(f"\nREGRESSIONS (threshold {args.threshold_pct:g}%):",
+              file=out)
+        for f in flags:
+            delta = (f"{f['change_pct']:+.2f}%"
+                     if f["change_pct"] is not None else "failed")
+            print(f"  {f['metric']}: r{f['from_round']:02d} "
+                  f"{_fmt_val(f['from'])} -> r{f['to_round']:02d} "
+                  f"{_fmt_val(f['to'])} ({delta})", file=out)
+        return 1
+    print("\nno regressions between the last two healthy rounds",
+          file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

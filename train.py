@@ -89,6 +89,11 @@ def parse_args(argv=None):
     p.add_argument("--nan-guard", action="store_true",
                    help="Divergence sentinel: verify step losses are finite (in windowed deferred fetches), roll back to the last-good snapshot and skip the offending batch on NaN/Inf, bounded per epoch")
     p.add_argument("--tensorboard", action="store_true", help="Write TensorBoard scalars to <rundir>/tb")
+    p.add_argument("--perf-csv", action="store_true",
+                   help="Append windowed perf columns (mfu_live, hbm_peak_bytes; "
+                   "nan where unmeasurable, e.g. on CPU) to metrics-train.csv. "
+                   "Off by default so deterministic-replay byte comparisons of "
+                   "the CSV stay wall-clock free")
     p.add_argument("--distill", action="store_true",
                    help="Distill the full quality pipeline into a compact CAN student (the fast serving tier, docs/SERVING.md 'Quality tiers'): the trained model becomes models/can.CANStudent mapping raw RGB directly to the frozen WaterNet teacher's output; every loss and metric (incl. the val ssim/psnr columns) reads as student-vs-teacher fidelity. Teacher weights come from --teacher-weights (or the standard weight resolution); --weights still names the TRAINED model's starting weights (a student checkpoint to continue from)")
     p.add_argument("--teacher-weights", type=str,
@@ -294,6 +299,11 @@ def main(argv=None):
     )
     saved_train = {k: [] for k in TRAIN_METRICS_NAMES}
     saved_val = {k: [] for k in VAL_METRICS_NAMES}
+    # --perf-csv: one row per completed epoch, aligned to saved_train's
+    # TAIL at write time (resumed histories have no perf for the epochs
+    # trained by the previous process — those rows pad with nan).
+    PERF_CSV_COLS = ("mfu_live", "hbm_peak_bytes")
+    saved_perf = {k: [] for k in PERF_CSV_COLS}
     start_epoch = 0
     start_batch = 0
     carry = None
@@ -477,6 +487,11 @@ def main(argv=None):
                 saved_train.setdefault(k, []).append(v)
             for k, v in val_metrics.items():
                 saved_val.setdefault(k, []).append(v)
+            if args.perf_csv:
+                snap = engine.perf.epoch_snapshot()
+                for k in PERF_CSV_COLS:
+                    v = snap.get(k)
+                    saved_perf[k].append(np.nan if v is None else float(v))
 
             if tb_writer is not None:
                 import tensorflow as tf
@@ -531,9 +546,21 @@ def main(argv=None):
     savedir.mkdir(parents=True, exist_ok=True)  # --epochs 0: loop never ran
     train_arr = np.stack([np.asarray(saved_train[k]) for k in TRAIN_METRICS_NAMES], 1)
     val_arr = np.stack([np.asarray(saved_val[k]) for k in VAL_METRICS_NAMES], 1)
+    train_header = list(TRAIN_METRICS_NAMES)
+    if args.perf_csv and train_arr.size:
+        n = train_arr.shape[0]
+        perf_cols = []
+        for k in PERF_CSV_COLS:
+            col = np.full(n, np.nan)
+            vals = saved_perf[k][-n:]
+            if vals:
+                col[n - len(vals):] = vals
+            perf_cols.append(col)
+        train_arr = np.concatenate([train_arr, np.stack(perf_cols, 1)], 1)
+        train_header += list(PERF_CSV_COLS)
     np.savetxt(
         savedir / "metrics-train.csv", train_arr, fmt="%f", delimiter=",",
-        comments="", header=",".join(TRAIN_METRICS_NAMES),
+        comments="", header=",".join(train_header),
     )
     np.savetxt(
         savedir / "metrics-val.csv", val_arr, fmt="%f", delimiter=",",

@@ -60,6 +60,8 @@ from concurrent.futures import ThreadPoolExecutor
 from contextlib import contextmanager
 from typing import Callable, Iterable, Iterator, Optional
 
+from waternet_tpu.obs import window as obswin
+
 THREAD_PREFIX = "waternet-pipeline"
 
 STAGES = ("load", "preprocess", "transfer", "step")
@@ -85,6 +87,13 @@ class PipelineStats:
         self.workers = 0  # guarded-by: self._lock
         self._transfer_bytes = 0  # guarded-by: self._lock
         self._transfer_batches = 0  # guarded-by: self._lock
+        # Windowed twin of pops/stalls (self-locked primitives, fed
+        # outside self._lock): the lifetime stall_pct dilutes a
+        # late-epoch stall regression under hours of healthy history;
+        # stall_pct_window answers "is the input pipeline keeping up
+        # NOW" (docs/OBSERVABILITY.md "Windows & SLOs").
+        self._win_pops = obswin.WindowedCounter()
+        self._win_stalls = obswin.WindowedCounter()
 
     def set_workers(self, n: int) -> None:
         """Declare the worker count feeding this stats object. Locked
@@ -127,6 +136,9 @@ class PipelineStats:
                 self.stall_s += waited_s
             self._depth_sum += depth
             self.depth_max = max(self.depth_max, depth)
+        self._win_pops.add(1)
+        if stalled:
+            self._win_stalls.add(1)
 
     def stage_ms(self, name: str) -> float:
         """Mean per-call milliseconds for ``name`` (0.0 when never timed)."""
@@ -137,6 +149,13 @@ class PipelineStats:
     def stall_pct(self) -> float:
         with self._lock:
             return 100.0 * self.stalls / max(self.pops, 1)
+
+    def stall_pct_window(self) -> float:
+        """Stall percentage over the trailing window only."""
+        pops = self._win_pops.total()
+        if pops <= 0:
+            return 0.0
+        return 100.0 * self._win_stalls.total() / pops
 
     def queue_depth_mean(self) -> float:
         with self._lock:
@@ -153,6 +172,7 @@ class PipelineStats:
             workers = float(self.workers)
         out = {
             f"{prefix}stall_pct": round(self.stall_pct(), 2),
+            f"{prefix}stall_pct_window": round(self.stall_pct_window(), 2),
             f"{prefix}queue_depth": round(self.queue_depth_mean(), 2),
             f"{prefix}workers": workers,
             f"{prefix}transfer_bytes_per_batch": round(

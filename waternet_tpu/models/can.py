@@ -153,6 +153,23 @@ def flops_ratio(
     return teacher_pipeline_flops(h, w) / can_forward_flops(h, w, width, depth)
 
 
+def train_flops_per_image(
+    h: int, w: int,
+    width: int = DEFAULT_WIDTH, depth: int = DEFAULT_DEPTH,
+    distill: bool = False,
+) -> int:
+    """Per-image FLOPs of one training step: the standard fwd + 2x-bwd
+    conv estimate (3x forward), plus one inference-only teacher forward
+    under distillation. An analytic figure for the live MFU gauge —
+    expect it below XLA's counted ``cost_analysis`` FLOPs (which include
+    loss/metric/optimizer arithmetic); the gap in bench output is the
+    cost-model delta, not a measurement error."""
+    total = 3 * can_forward_flops(h, w, width, depth)
+    if distill:
+        total += waternet_forward_flops(h, w)
+    return total
+
+
 # ----------------------------------------------------------------------
 # Param-tree validation — one vocabulary for "these weights are not a
 # student" (serving engines, hub loaders, hot-reload style checks).

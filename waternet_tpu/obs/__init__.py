@@ -14,13 +14,29 @@ them one shared instrument set:
 * :mod:`waternet_tpu.obs.prometheus` — Prometheus text-format rendering
   of :meth:`waternet_tpu.serving.stats.ServingStats.summary`, served by
   the front door as ``GET /metrics`` (one vocabulary with ``/stats``).
+* :mod:`waternet_tpu.obs.window` — sliding-window metric primitives
+  (log-linear HDR-style histograms in a lazy shard ring, windowed
+  counters/rates, last-value gauges): the "what is the p99 NOW" layer
+  under ``/stats``'s ``latency_ms_window``/``window`` blocks, the
+  trainer's live images-per-sec, and the /metrics histogram types.
+* :mod:`waternet_tpu.obs.slo` — ``--slo`` objective grammar, multi-
+  window burn rates, and the deterministic ok → warn → page state
+  machines that grade ``/healthz`` and export alert-state gauges.
+* :mod:`waternet_tpu.obs.device` — peak-TFLOPs table and HBM
+  ``memory_stats()`` wrappers for the MFU/HBM gauges (NOT re-exported
+  here: it handles jax device objects, and this package's import
+  surface must stay stdlib-only for the CLI).
 * :mod:`waternet_tpu.obs.cli` — the ``waternet-trace`` console entry:
   per-stage latency breakdowns, critical-path attribution for the
-  slowest requests, and supervisor timelines from heartbeat dirs.
+  slowest requests, supervisor timelines from heartbeat dirs, and the
+  ``slo`` ledger-replay mode.
 
 Tracing is OFF by default; when disabled every hook is a single
 attribute load + bool check (the ``obs_overhead_pct`` bench pins the
-armed cost at ≤ 2%). The recorder spawns no threads of its own.
+armed cost at ≤ 2% for the whole stack — spans, windows, and SLO
+evaluation together). Windows are ON by default (they ARE the /metrics
+vocabulary) but share the same disabled-is-free switch for the bench
+A/B. Nothing here spawns threads of its own.
 """
 
 from waternet_tpu.obs.trace import (  # noqa: F401
@@ -39,3 +55,16 @@ from waternet_tpu.obs.trace import (  # noqa: F401
     span,
 )
 from waternet_tpu.obs.prometheus import render_prometheus  # noqa: F401
+from waternet_tpu.obs.slo import (  # noqa: F401
+    SloEngine,
+    SloObjective,
+    WindowSample,
+    parse_slo,
+    replay_ledger,
+)
+from waternet_tpu.obs.window import (  # noqa: F401
+    Gauge,
+    LogLinearHistogram,
+    WindowedCounter,
+    WindowedHistogram,
+)

@@ -1,6 +1,6 @@
 """``waternet-trace`` — read traces, answer "where did the time go".
 
-Two modes (docs/OBSERVABILITY.md "Reading a trace"):
+Three modes (docs/OBSERVABILITY.md "Reading a trace" / "Windows & SLOs"):
 
 ``waternet-trace trace.json``
     Loads a Chrome trace-event file exported by
@@ -19,6 +19,15 @@ Two modes (docs/OBSERVABILITY.md "Reading a trace"):
     additionally folds the timeline into Chrome trace form (one pid per
     generation, one tid per worker) so supervisor history opens in the
     same Perfetto UI as serving traces.
+
+``waternet-trace slo ledger.json --slo "p99_ms<=250,..."``
+    Replays a request ledger (``waternet-loadgen --ledger``, or any
+    JSON list of ``{"t", "latency_ms", "outcome"}`` rows) through the
+    SAME windows and burn-rate state machines the live server runs
+    (:mod:`waternet_tpu.obs.slo`), printing every ok/warn/page
+    transition with its ledger timestamp and the final per-objective
+    burn table. Exit 1 when any objective ends paging — usable as a
+    post-hoc gate on a recorded load test.
 
 Pure stdlib; never imports jax (safe on hosts without an accelerator).
 """
@@ -241,6 +250,91 @@ def _train_timeline(root: Path, export: Optional[str], out=None) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# SLO ledger replay (waternet-trace slo)
+# ---------------------------------------------------------------------------
+
+
+def _load_ledger(path: Path) -> list:
+    """Accept a bare entry list, ``{"ledger": [...]}``, or a full
+    loadgen report that embedded its ledger."""
+    doc = json.loads(path.read_text())
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict) and isinstance(doc.get("ledger"), list):
+        return doc["ledger"]
+    raise ValueError(
+        "expected a JSON list of ledger entries or an object with a "
+        "'ledger' key"
+    )
+
+
+def _slo_replay(args, out=None) -> int:
+    out = out or sys.stdout  # bind late: tests capture sys.stdout
+    from waternet_tpu.obs.slo import parse_slo, replay_ledger
+
+    path = Path(args.ledger)
+    try:
+        entries = _load_ledger(path)
+        objectives = parse_slo(args.slo)
+    except (OSError, ValueError) as e:
+        print(f"waternet-trace slo: {e}", file=sys.stderr)
+        return 2
+    transitions, block = replay_ledger(
+        entries,
+        objectives,
+        step_sec=args.step_sec,
+        short_sec=args.short_sec,
+        long_sec=args.long_sec,
+        hold_sec=args.hold_sec,
+    )
+    n = len(entries)
+    span = max((float(e.get("t", 0.0)) for e in entries), default=0.0)
+    print(f"slo replay: {n} ledger entries over {span:.1f}s "
+          f"(windows {args.short_sec:g}s/{args.long_sec:g}s, "
+          f"eval every {args.step_sec:g}s)", file=out)
+    if transitions:
+        print("transitions:", file=out)
+        for tr in transitions:
+            print(f"  t={tr['at']:>9.1f}s  {tr['objective']:<24} "
+                  f"{tr['from']} -> {tr['to']}", file=out)
+    else:
+        print("transitions: none", file=out)
+    print("final state:", file=out)
+    print(f"  {'objective':<24} {'state':<6} {'short_burn':>10} "
+          f"{'long_burn':>10}", file=out)
+    paging = False
+    for row in block.get("objectives", []):
+        print(f"  {row['objective']:<24} {row['state']:<6} "
+              f"{row['short_burn']:>10.3f} {row['long_burn']:>10.3f}",
+              file=out)
+        paging = paging or row["state"] == "page"
+    print(f"grade: {block.get('grade', 'ok')}", file=out)
+    return 1 if paging else 0
+
+
+def build_slo_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="waternet-trace slo",
+        description="Replay a loadgen/bench request ledger through the "
+        "serving SLO burn-rate engine, offline.",
+    )
+    p.add_argument("ledger",
+                   help="ledger JSON (waternet-loadgen --ledger output, "
+                        "a bare entry list, or a report with a 'ledger' key)")
+    p.add_argument("--slo", required=True, metavar="SPEC",
+                   help='objectives, e.g. "p99_ms<=250,error_rate<=0.01"')
+    p.add_argument("--step-sec", type=float, default=1.0,
+                   help="engine evaluation cadence in ledger time")
+    p.add_argument("--short-sec", type=float, default=60.0,
+                   help="fast burn window")
+    p.add_argument("--long-sec", type=float, default=300.0,
+                   help="sustained burn window")
+    p.add_argument("--hold-sec", type=float, default=60.0,
+                   help="quiet time required before de-escalation")
+    return p
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="waternet-trace",
@@ -259,6 +353,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "slo":
+        return _slo_replay(build_slo_parser().parse_args(argv[1:]))
     args = build_parser().parse_args(argv)
     if args.train_root:
         return _train_timeline(Path(args.train_root), args.export)

@@ -1,0 +1,91 @@
+"""Device-level gauge sources: peak TFLOP/s and HBM memory stats.
+
+Home of the peak-FLOPs spec table (bench.py delegates here) and thin,
+never-throwing wrappers over PJRT's ``device.memory_stats()`` so the
+trainer and bench can publish HBM gauges with a graceful ``None`` on
+backends that don't expose them (CPU, some tunnelled plugins).
+
+jax is touched only through the ``device`` objects callers pass in —
+importing this module never imports jax, but it is deliberately NOT
+re-exported from ``waternet_tpu.obs`` so the stdlib-only CLI surface
+stays obviously accelerator-free.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# Dense bf16 peak TFLOP/s per chip, by PJRT device_kind substring (public
+# cloud.google.com/tpu spec sheet numbers). MFU is computed against this;
+# override with WATERNET_TPU_PEAK_TFLOPS for unlisted hardware.
+PEAK_TFLOPS_BY_KIND = (
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+)
+
+
+def peak_tflops(device) -> Optional[float]:
+    """Peak dense bf16 TFLOP/s for ``device``, or None when unknowable.
+
+    Resolution order: WATERNET_TPU_PEAK_TFLOPS env override, then the
+    device_kind substring table, then the PALLAS_AXON_TPU_GEN env hint
+    for tunnelled PJRT plugins with opaque kinds — but never for the
+    host CPU platform, where "MFU vs TPU peak" would be noise.
+    """
+    env = os.environ.get("WATERNET_TPU_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in PEAK_TFLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    if getattr(device, "platform", "") == "cpu":
+        return None
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for sub, peak in PEAK_TFLOPS_BY_KIND:
+        if gen and sub.replace(" ", "") in gen.replace(" ", ""):
+            return peak
+    return None
+
+
+def hbm_stats(device) -> Optional[Dict[str, int]]:
+    """``device.memory_stats()`` as a plain dict, or None when the
+    backend doesn't implement it (CPU) or it raises."""
+    fn = getattr(device, "memory_stats", None)
+    if fn is None:
+        return None
+    try:
+        stats = fn()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return dict(stats)
+
+
+def hbm_peak_bytes(device) -> Optional[int]:
+    """Peak bytes in use on ``device``, preferring PJRT's own high-water
+    mark and falling back to current usage; None when unavailable."""
+    stats = hbm_stats(device)
+    if stats is None:
+        return None
+    for key in ("peak_bytes_in_use", "bytes_in_use"):
+        v = stats.get(key)
+        if v is not None:
+            return int(v)
+    return None
+
+
+def hbm_limit_bytes(device) -> Optional[int]:
+    """Total allocatable HBM bytes, when the backend reports it."""
+    stats = hbm_stats(device)
+    if stats is None:
+        return None
+    v = stats.get("bytes_limit")
+    return int(v) if v is not None else None

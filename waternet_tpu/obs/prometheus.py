@@ -19,7 +19,14 @@ Mapping conventions:
   the Prometheus summary type;
 * replica health is one ``waternet_replica_health`` sample per replica
   with ``tier``/``replica``/``state`` labels and value 1 — the state is
-  a label so dashboards can group on it without a state→number codec.
+  a label so dashboards can group on it without a state→number codec;
+* the windowed latency distribution is a TRUE Prometheus ``histogram``
+  (cumulative ``le`` buckets + ``_sum`` + ``_count``), rendered from
+  the ``window.latency_hist_ms`` block — burn rates and heatmaps need
+  the distribution, not pre-baked quantiles; windowed quantiles and
+  rates ride alongside as gauges, and the armed SLO engine (if any)
+  exports per-objective state (ok=0 / warn=1 / page=2) and short/long
+  burn gauges.
 
 No external client library: the text format is a few lines of string
 assembly, and the repo's no-new-deps rule holds.
@@ -77,8 +84,27 @@ class _Writer:
     def one(self, name, mtype, help_text, value, labels=None) -> None:
         self.metric(name, mtype, help_text, [(labels, value)])
 
+    def histogram(self, name: str, help_text: str, block: dict) -> None:
+        """A true Prometheus histogram from an ``obs.window``
+        ``histogram_block``: cumulative ``_bucket`` samples per ``le``
+        bound, the implicit ``+Inf`` bucket, ``_sum`` and ``_count``."""
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} histogram")
+        for le, cum in zip(block["le"], block["cumulative"]):
+            self.lines.append(
+                _sample(f"{name}_bucket", {"le": _fmt(float(le))}, cum))
+        self.lines.append(
+            _sample(f"{name}_bucket", {"le": "+Inf"}, block["count"]))
+        self.lines.append(_sample(f"{name}_sum", None, block["sum"]))
+        self.lines.append(_sample(f"{name}_count", None, block["count"]))
+
     def text(self) -> str:
         return "\n".join(self.lines) + "\n"
+
+
+#: Alert states as exported gauge values (grouping and alerting both
+#: want a total order: paging > warning > healthy).
+SLO_STATE_VALUES = {"ok": 0, "warn": 1, "page": 2}
 
 
 def render_prometheus(summary: dict) -> str:
@@ -212,4 +238,57 @@ def render_prometheus(summary: dict) -> str:
         "Cumulative device-busy wall time per replica (s).",
         [({"replica": r["replica"]}, r["busy_sec"]) for r in per_replica],
     )
+
+    # --- sliding windows + SLO (PR 15; .get keeps older summaries legal)
+    win = summary.get("window")
+    if win:
+        w.histogram(
+            "waternet_request_latency_window_ms",
+            f"Request latency over the trailing {win['long_window_sec']:g}s "
+            "window (ms).",
+            win["latency_hist_ms"],
+        )
+        w.metric(
+            "waternet_request_latency_window_quantile_ms", "gauge",
+            f"Windowed ({win['window_sec']:g}s) latency quantiles (ms).",
+            [({"quantile": q}, win["latency_ms"][p])
+             for q, p in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99"))],
+        )
+        w.metric(
+            "waternet_tier_latency_window_p99_ms", "gauge",
+            "Windowed per-tier latency p99 (ms).",
+            [({"tier": tier}, q["p99"])
+             for tier, q in sorted(win["tiers"].items())],
+        )
+        w.one("waternet_requests_per_sec_window", "gauge",
+              "Completed-request rate over the trailing window.",
+              win["requests_per_sec"])
+        w.one("waternet_shed_per_sec_window", "gauge",
+              "Shed rate over the trailing window.", win["shed_per_sec"])
+        w.one("waternet_error_rate_window", "gauge",
+              "Error fraction over the trailing window.", win["error_rate"])
+        w.one("waternet_queue_depth_window_p99", "gauge",
+              "Windowed queue-depth p99 at batch launch.",
+              win["queue_depth"]["p99"])
+
+    slo = summary.get("slo")
+    if slo:
+        w.metric(
+            "waternet_slo_state", "gauge",
+            "Per-objective alert state (0=ok, 1=warn, 2=page).",
+            [({"objective": o["objective"]},
+              SLO_STATE_VALUES.get(o["state"], 0))
+             for o in slo["objectives"]],
+        )
+        w.metric(
+            "waternet_slo_burn", "gauge",
+            "Per-objective burn rate (1.0 = burning budget exactly).",
+            [({"objective": o["objective"], "window": wname}, o[key])
+             for o in slo["objectives"]
+             for wname, key in (("short", "short_burn"),
+                                ("long", "long_burn"))],
+        )
+        w.one("waternet_slo_degraded", "gauge",
+              "1 when any SLO objective is paging.",
+              1 if slo["grade"] == "degraded" else 0)
     return w.text()

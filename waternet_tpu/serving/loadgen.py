@@ -28,6 +28,14 @@ docs/SERVING.md "Fault isolation") — drive opt-in traffic with
 ``POST /stream`` sessions (open-loop — live cameras do not slow down for
 a busy server) with per-frame latency / drop / downgrade accounting and
 the same conn_reset-vs-errors split.
+
+Both modes add a ``window`` block — throughput and p50/p99 over only
+the trailing ``--window-sec`` (default 10 s) of completions, the figure
+that survives a run long enough to degrade (a lifetime average lets the
+fast first minute pay for the saturated last one). ``--ledger PATH``
+(request mode) additionally records every request as
+``{"t", "latency_ms", "outcome"}`` for offline SLO replay:
+``waternet-trace slo PATH --slo "p99_ms<=250,..."``.
 """
 
 from __future__ import annotations
@@ -52,6 +60,37 @@ from waternet_tpu.serving.stats import _percentile
 #: and ``failures_truncated`` says by how much).
 MAX_FAILURE_RECORDS = 128
 
+#: Default trailing span for the report's ``window`` block.
+DEFAULT_WINDOW_SEC = 10.0
+
+
+def _window_block(
+    samples: List, window_sec: float, now: Optional[float] = None
+) -> Dict:
+    """Trailing-``window_sec`` throughput/latency from completion
+    ``(t, latency_sec)`` samples (``t`` relative to run start).
+
+    Lifetime averages hide the end state of a run that degrades —
+    the first fast minute pays for the last saturated one. This block
+    reports only completions with ``t`` in ``(now - window_sec, now]``;
+    the rate divisor is ``min(window_sec, now)`` so a run shorter than
+    the window is not under-reported. Pure so tests can pin it without
+    a server.
+    """
+    if now is None:
+        now = max((t for t, _ in samples), default=0.0)
+    recent = sorted(lat for t, lat in samples if t > now - window_sec)
+    span = max(min(window_sec, now), 1e-9)
+    return {
+        "window_sec": float(window_sec),
+        "count": len(recent),
+        "requests_per_sec": round(len(recent) / span, 2),
+        "latency_ms": {
+            "p50": round(_percentile(recent, 0.50) * 1e3, 3),
+            "p99": round(_percentile(recent, 0.99) * 1e3, 3),
+        },
+    }
+
 
 def run_load(
     url: str,
@@ -64,6 +103,8 @@ def run_load(
     keep_bodies: bool = False,
     tier: Optional[str] = None,
     allow_downgrade: bool = False,
+    window_sec: float = DEFAULT_WINDOW_SEC,
+    collect_ledger: bool = False,
 ) -> Dict:
     """Drive ``total`` POSTs at ``path`` with ``concurrency`` closed-loop
     workers cycling through ``payloads``; returns the accounting report.
@@ -81,6 +122,14 @@ def run_load(
     (docs/OBSERVABILITY.md): the report's ``failures`` ledger lists each
     non-ok request's id and outcome, so a shed/reset/error in a load run
     is findable in the server-side trace by the same id.
+
+    The report's ``window`` block restates throughput and p50/p99 over
+    only the trailing ``window_sec`` of completions (see
+    :func:`_window_block`) — the figure to read on a run long enough to
+    degrade. ``collect_ledger=True`` additionally returns ``ledger``:
+    one ``{"t", "latency_ms", "outcome"}`` entry per request (``t``
+    seconds from run start), the input format of ``waternet-trace slo``
+    offline replay (docs/OBSERVABILITY.md).
     """
     u = urlparse(url)
     host, port = u.hostname, u.port or 80
@@ -91,6 +140,8 @@ def run_load(
         "conn_reset": 0, "errors": 0, "downgraded": 0,
     }
     latencies: List[float] = []
+    samples: List = []  # (t_done - t0, latency_sec) for ok requests
+    ledger_entries: List[Dict] = []
     bodies: List = []
     failures: List[Dict] = []
     truncated = [0]
@@ -102,6 +153,18 @@ def run_load(
             failures.append(rec)
         else:
             truncated[0] += 1
+
+    def record_ledger(rel_t: float, outcome: str,
+                      latency_s: Optional[float]) -> None:
+        # Caller holds `lock`.
+        if collect_ledger:
+            ledger_entries.append({
+                "t": round(rel_t, 6),
+                "latency_ms": (
+                    None if latency_s is None else round(latency_s * 1e3, 3)
+                ),
+                "outcome": outcome,
+            })
 
     def worker():
         import http.client
@@ -155,16 +218,22 @@ def run_load(
                             "outcome": key,
                             "error": type(err).__name__,
                         })
+                        record_ledger(
+                            time.perf_counter() - t_run0, key, None
+                        )
                     conn.close()
                     conn = http.client.HTTPConnection(
                         host, port, timeout=timeout
                     )
                     continue
-                dt = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                dt = t1 - t0
                 with lock:
                     if status == 200:
                         counts["ok"] += 1
                         latencies.append(dt)
+                        samples.append((t1 - t_run0, dt))
+                        record_ledger(t1 - t_run0, "ok", dt)
                         # Only meaningful when a tier was REQUESTED: a
                         # fast-default server answering tier-less traffic
                         # with X-Tier-Served: fast is not a downgrade.
@@ -183,6 +252,7 @@ def run_load(
                             "outcome": outcome,
                             "status": status,
                         })
+                        record_ledger(t1 - t_run0, outcome, None)
                     if keep_bodies:
                         bodies.append((i, status, body))
                 if closed:
@@ -199,12 +269,12 @@ def run_load(
         )
         for i in range(max(1, int(concurrency)))
     ]
-    t0 = time.perf_counter()
+    t_run0 = time.perf_counter()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    elapsed = time.perf_counter() - t0
+    elapsed = time.perf_counter() - t_run0
 
     lat_sorted = sorted(latencies)
     report = {
@@ -217,6 +287,7 @@ def run_load(
             "p50": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
             "p99": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
         },
+        "window": _window_block(samples, window_sec, now=elapsed),
         "request_id_prefix": f"lg-{run_tag}",
         "failures": failures,
     }
@@ -224,6 +295,8 @@ def run_load(
         report["failures_truncated"] = truncated[0]
     if keep_bodies:
         report["bodies"] = bodies
+    if collect_ledger:
+        report["ledger"] = sorted(ledger_entries, key=lambda e: e["t"])
     return report
 
 
@@ -263,6 +336,7 @@ def run_stream_load(
     tier: Optional[str] = None,
     allow_downgrade: bool = False,
     timeout: float = 120.0,
+    window_sec: float = DEFAULT_WINDOW_SEC,
 ) -> Dict:
     """Replay ``payloads`` as ``streams`` paced concurrent POST /stream
     sessions (``frames`` frames each at ``fps``); returns the aggregate
@@ -295,6 +369,7 @@ def run_stream_load(
     }
     totals = {"frames_sent": 0}
     latencies: List[float] = []
+    samples: List = []  # (t_recv - t_run0, latency_sec) delivered frames
     failures: List[Dict] = []
 
     def record_failure(rec: Dict) -> None:
@@ -396,6 +471,9 @@ def run_stream_load(
                                 counts["downgraded"] += 1
                             if seq in t_sent:
                                 latencies.append(t_recv - t_sent[seq])
+                                samples.append(
+                                    (t_recv - t_run0, t_recv - t_sent[seq])
+                                )
                         elif kind == b"D":
                             reason = json.loads(payload).get("reason")
                             counts[
@@ -450,12 +528,12 @@ def run_stream_load(
         )
         for i in range(max(1, int(streams)))
     ]
-    t0 = time.perf_counter()
+    t_run0 = time.perf_counter()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    elapsed = time.perf_counter() - t0
+    elapsed = time.perf_counter() - t_run0
 
     lat_sorted = sorted(latencies)
     ok = counts["ok"]
@@ -473,6 +551,7 @@ def run_stream_load(
             "p50": round(_percentile(lat_sorted, 0.50) * 1e3, 3),
             "p99": round(_percentile(lat_sorted, 0.99) * 1e3, 3),
         },
+        "window": _window_block(samples, window_sec, now=elapsed),
         "request_id_prefix": f"lg-{run_tag}",
         "failures": failures,
     }
@@ -510,6 +589,18 @@ def main(argv=None) -> int:
     parser.add_argument("--concurrency", type=int, default=4)
     parser.add_argument("--requests", type=int, default=64)
     parser.add_argument("--deadline-ms", type=float, default=None)
+    parser.add_argument(
+        "--window-sec", type=float, default=DEFAULT_WINDOW_SEC,
+        help="Trailing span of the report's 'window' block "
+        "(throughput + p50/p99 over only the last N seconds of "
+        "completions — the figure to read on a long degrading run).",
+    )
+    parser.add_argument(
+        "--ledger", type=str, default=None,
+        help="Write every request's {t, latency_ms, outcome} to this "
+        "JSON file — replayable offline against an SLO spec with "
+        "'waternet-trace slo LEDGER --slo ...' (request mode only).",
+    )
     parser.add_argument(
         "--tier", type=str, default=None,
         choices=["quality", "fast"],
@@ -579,6 +670,7 @@ def main(argv=None) -> int:
             window=args.window,
             tier=args.tier,
             allow_downgrade=args.allow_downgrade,
+            window_sec=args.window_sec,
         )
         print(json.dumps(report))
         return 0
@@ -590,7 +682,15 @@ def main(argv=None) -> int:
         deadline_ms=args.deadline_ms,
         tier=args.tier,
         allow_downgrade=args.allow_downgrade,
+        window_sec=args.window_sec,
+        collect_ledger=args.ledger is not None,
     )
+    if args.ledger is not None:
+        from pathlib import Path
+
+        Path(args.ledger).write_text(
+            json.dumps({"ledger": report.pop("ledger", [])})
+        )
     print(json.dumps(report))
     return 0
 

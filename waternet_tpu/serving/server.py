@@ -222,6 +222,7 @@ class ServingServer:
         downgrade_watermark: Optional[int] = None,
         max_streams: int = 8,
         stream_window: int = 8,
+        slo: Optional[str] = None,
     ):
         if admit_watermark is None:
             # Shed before QueueFull would fire: the watermark is the soft
@@ -249,6 +250,14 @@ class ServingServer:
         self.max_streams = int(max_streams)
         self.stream_window = int(stream_window)
         self.stats = stats if stats is not None else ServingStats()
+        self.slo_spec = slo
+        if slo:
+            from waternet_tpu.obs.slo import SloEngine, parse_slo
+
+            # Parse errors surface at construction (bad --slo exits the
+            # CLI before any engine warms), and the armed engine grades
+            # /healthz and annotates /stats + /metrics from then on.
+            self.stats.arm_slo(SloEngine(parse_slo(slo), spec=slo))
         self.batcher: Optional[DynamicBatcher] = None
         self.streams: Optional[StreamManager] = None
         self.bound_port: Optional[int] = None
@@ -602,7 +611,21 @@ class ServingServer:
             payload["ready"] = False  # a tier with zero available replicas
             payload["status"] = "unhealthy"
             return self._json(writer, 503, payload)
-        payload["status"] = "degraded" if any_sick else "ok"
+        # An armed SLO engine grades health too: a paging objective turns
+        # an otherwise-green pool "degraded" (still 200 — it is serving,
+        # just out of budget; docs/OBSERVABILITY.md "Windows & SLOs").
+        slo_block = self.stats.slo_state()
+        slo_degraded = False
+        if slo_block is not None:
+            payload["slo"] = {
+                "grade": slo_block["grade"],
+                "state": slo_block["state"],
+                "spec": slo_block["spec"],
+            }
+            slo_degraded = slo_block["grade"] == "degraded"
+        payload["status"] = (
+            "degraded" if (any_sick or slo_degraded) else "ok"
+        )
         return self._json(writer, 200, payload)
 
     # -- /enhance ------------------------------------------------------
@@ -1075,6 +1098,15 @@ def parse_args(argv=None):
         "X-Stream-Window).",
     )
     parser.add_argument(
+        "--slo", type=str, default=None, metavar="SPEC",
+        help="Arm the SLO engine with a comma-separated objective list, "
+        'e.g. "p99_ms<=250,error_rate<=0.01,availability>=0.999". '
+        "Objectives are evaluated as multi-window burn rates; a paging "
+        "objective grades /healthz degraded, and /stats + /metrics gain "
+        "per-objective state and burn (docs/OBSERVABILITY.md "
+        "'Windows & SLOs').",
+    )
+    parser.add_argument(
         "--precision", type=str, default="fp32", choices=["fp32", "bf16"],
     )
     return parser.parse_args(argv)
@@ -1145,6 +1177,7 @@ def main(argv=None) -> int:
         downgrade_watermark=args.downgrade_watermark,
         max_streams=args.max_streams,
         stream_window=args.stream_window,
+        slo=args.slo,
     )
     return server.run(install_signal_handlers=True)
 

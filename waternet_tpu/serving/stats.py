@@ -13,7 +13,10 @@ import json
 import random
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+from waternet_tpu.obs import window as obswin
+from waternet_tpu.obs.slo import SloEngine, WindowSample
 
 #: Latency reservoir size: percentiles are computed over at most this many
 #: uniformly-sampled requests (algorithm R), so a long-lived server's
@@ -27,6 +30,84 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
         return 0.0
     idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
     return sorted_vals[idx]
+
+
+class _ServingWindows:
+    """The sliding-window view of one ServingStats instance.
+
+    Every primitive here is self-locked (obs/window.py); the only state
+    this class guards itself is the grow-only per-tier histogram dict.
+    ServingStats feeds these OUTSIDE its own ``_lock`` so no
+    stats-lock -> window-lock edge enters the lock-order graph.
+    """
+
+    def __init__(self, clock=None):
+        self._clock = clock if clock is not None else time.monotonic
+        span = obswin.DEFAULT_LONG_WINDOW_SEC
+        # Latencies recorded in MILLISECONDS — the unit every quantile,
+        # le-bucket, and SLO threshold in the schema speaks.
+        self.latency = obswin.WindowedHistogram(span, clock=clock)
+        self.queue_depth = obswin.WindowedHistogram(span, clock=clock)
+        self.stream_frame = obswin.WindowedHistogram(span, clock=clock)
+        self.ok = obswin.WindowedCounter(span, clock=clock)
+        self.errors = obswin.WindowedCounter(span, clock=clock)
+        self.shed = obswin.WindowedCounter(span, clock=clock)
+        self._lock = threading.Lock()
+        self._tier_latency: Dict[str, obswin.WindowedHistogram] = {}  # guarded-by: self._lock
+
+    def now(self) -> float:
+        return self._clock()
+
+    def tier_hist(self, tier: str) -> obswin.WindowedHistogram:
+        with self._lock:
+            hist = self._tier_latency.get(tier)
+            if hist is None:
+                hist = obswin.WindowedHistogram(
+                    obswin.DEFAULT_LONG_WINDOW_SEC, clock=self._clock)
+                self._tier_latency[tier] = hist
+        return hist
+
+    def tier_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tier_latency)
+
+    def sample(self, span: float) -> WindowSample:
+        """One window's observations in SLO-engine form."""
+        return WindowSample(
+            self.latency.merged(span),
+            ok=self.ok.total(span),
+            errors=self.errors.total(span),
+            shed=self.shed.total(span),
+        )
+
+    def block(self) -> dict:
+        """The ``window`` block of /stats: current-traffic quantiles and
+        rates over the short window, sustained quantiles over the long,
+        plus the raw le-ladder /metrics renders as a true histogram."""
+        short = obswin.DEFAULT_WINDOW_SEC
+        lat_short = self.latency.merged(short)
+        lat_long = self.latency.merged()
+        total = (self.ok.total(short) + self.errors.total(short)
+                 + self.shed.total(short))
+        return {
+            "window_sec": short,
+            "long_window_sec": obswin.DEFAULT_LONG_WINDOW_SEC,
+            "latency_ms": obswin.quantile_block(lat_short),
+            "latency_ms_long": obswin.quantile_block(lat_long),
+            "latency_hist_ms": obswin.histogram_block(lat_long),
+            "tiers": {
+                t: obswin.quantile_block(self.tier_hist(t).merged(short))
+                for t in self.tier_names()
+            },
+            "queue_depth": obswin.quantile_block(
+                self.queue_depth.merged(short), digits=1),
+            "stream_frame_ms": obswin.quantile_block(
+                self.stream_frame.merged(short)),
+            "requests_per_sec": round(self.ok.rate(short), 3),
+            "shed_per_sec": round(self.shed.rate(short), 3),
+            "error_rate": round(
+                self.errors.total(short) / total, 6) if total else 0.0,
+        }
 
 
 class ServingStats:
@@ -86,11 +167,24 @@ class ServingStats:
       ``active_streams`` gauge and per-session p99 map read through the
       probe the owning
       :class:`~waternet_tpu.serving.streams.StreamManager` registers
-      (0 / {} for stats objects nothing registered on).
+      (0 / {} for stats objects nothing registered on);
+    * **sliding windows** (``latency_ms_window`` + the ``window`` block,
+      docs/OBSERVABILITY.md "Windows & SLOs"): the same latency / queue
+      / shed / error signals over the trailing 60 s / 300 s, so a
+      post-incident scrape reports current health instead of the
+      lifetime reservoir's history — and, when :meth:`arm_slo` armed an
+      engine, the ``slo`` burn-rate block that grades /healthz.
     """
 
-    def __init__(self):
+    def __init__(self, clock=None):
         self._lock = threading.Lock()
+        # Windowed twin of the reservoirs below. Self-locked primitives,
+        # fed OUTSIDE self._lock (see _ServingWindows); the clock is
+        # injectable so window tests drive time without sleeping.
+        self.window = _ServingWindows(clock)
+        #: Armed SLO engine, or None. Assigned once by arm_slo before
+        #: serving traffic (server construction), read thereafter.
+        self._slo: Optional[SloEngine] = None
         # bounded reservoir sample (algorithm R)
         self._latencies_s: List[float] = []  # guarded-by: self._lock
         self._reservoir_rng = random.Random(0)  # guarded-by: self._lock
@@ -191,6 +285,13 @@ class ServingStats:
                 j = self._reservoir_rng.randrange(self.requests)
                 if j < LATENCY_RESERVOIR:
                     self._latencies_s[j] = seconds
+        # Window feeds stay outside self._lock: the primitives are
+        # self-locked, and nesting them under the stats lock would add
+        # lock-order edges for nothing.
+        ms = seconds * 1e3
+        self.window.latency.record(ms)
+        self.window.tier_hist(tier).record(ms)
+        self.window.ok.add(1)
 
     def record_batch(
         self, n_real: int, n_slots: int, real_px: int, padded_px: int,
@@ -212,6 +313,7 @@ class ServingStats:
             rep["total_slots"] += n_slots
             if self._t_first_batch is None:
                 self._t_first_batch = time.perf_counter()
+        self.window.queue_depth.record(queue_depth)
 
     def record_replica_busy(self, replica: int, seconds: float) -> None:
         """Launch->completion wall time of one batch on one replica —
@@ -229,12 +331,14 @@ class ServingStats:
         reject_admit fault) — load that was shed, not served."""
         with self._lock:
             self.shed += 1
+        self.window.shed.add(1)
 
     def record_deadline_expired(self) -> None:
         """One request whose deadline budget ran out before compute —
         rejected up front or dropped (not computed) at dispatch time."""
         with self._lock:
             self.deadline_expired += 1
+        self.window.errors.add(1)
 
     def record_retry(self, n: int = 1) -> None:
         """``n`` requests re-dispatched onto a surviving replica after
@@ -256,6 +360,7 @@ class ServingStats:
         (non-finite values or an all-zero canvas after D2H)."""
         with self._lock:
             self.nan_outputs += 1
+        self.window.errors.add(1)
 
     def record_quarantine(self) -> None:
         """One replica transitioned into quarantine (crash strikes or a
@@ -299,6 +404,7 @@ class ServingStats:
                 j = self._stream_rng.randrange(self.stream_frames_delivered)
                 if j < LATENCY_RESERVOIR:
                     self._stream_lat_s[j] = seconds
+        self.window.stream_frame.record(seconds * 1e3)
 
     def record_stream_drop(self, reason: str) -> None:
         """One stream frame deliberately not delivered. ``reason``
@@ -351,6 +457,30 @@ class ServingStats:
             "p95": round(_percentile(vals, 0.95) * 1e3, 3),
             "p99": round(_percentile(vals, 0.99) * 1e3, 3),
         }
+
+    def latency_ms_window(self) -> Dict[str, float]:
+        """Trailing-window latency quantiles — what the server is doing
+        NOW, next to the lifetime reservoir's :meth:`latency_ms`."""
+        return obswin.quantile_block(
+            self.window.latency.merged(obswin.DEFAULT_WINDOW_SEC))
+
+    def arm_slo(self, engine: SloEngine) -> None:
+        """Attach an SLO engine (``--slo`` on the serving CLI). Called
+        once at server construction, before traffic."""
+        self._slo = engine
+
+    def slo_state(self) -> Optional[dict]:
+        """Evaluate the armed SLO engine against the current windows
+        (one state-machine tick per call — scrape-driven, like every
+        burn-rate evaluator). None when no engine is armed."""
+        engine = self._slo
+        if engine is None:
+            return None
+        return engine.evaluate(
+            self.window.now(),
+            self.window.sample(engine.short_sec),
+            self.window.sample(engine.long_sec),
+        )
 
     def images_per_sec(self) -> float:
         """Aggregate completed-requests throughput over the first-dispatch
@@ -450,6 +580,7 @@ class ServingStats:
             "requests": requests,
             "batches": batches,
             "latency_ms": self.latency_ms(),
+            "latency_ms_window": self.latency_ms_window(),
             "batch_occupancy": round(self.occupancy(), 4),
             "padding_overhead": round(self.padding_overhead(), 4),
             "compiles": compiles,
@@ -474,6 +605,8 @@ class ServingStats:
             "tiers": tiers,
             "streams": streams,
             "per_replica": self.per_replica(),
+            "window": self.window.block(),
+            "slo": self.slo_state(),
         }
 
     def to_json(self) -> str:

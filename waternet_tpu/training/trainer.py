@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 import time
 from typing import Any, Optional
 
@@ -41,8 +42,14 @@ from waternet_tpu.data.augment import (
     draw_augment,
 )
 from waternet_tpu.models import WaterNet
+from waternet_tpu.models.can import (
+    train_flops_per_image,
+    waternet_forward_flops,
+)
 from waternet_tpu.models.vgg import VGG19Features
+from waternet_tpu.obs import device as obsdevice
 from waternet_tpu.obs import trace
+from waternet_tpu.obs import window as obswin
 from waternet_tpu.ops.fused import fused_train_preprocess
 from waternet_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -210,6 +217,114 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     return optax.adam(learning_rate=schedule)
 
 
+def _payload_images(payload) -> int:
+    """Real image rows in one dispatch payload — every epoch driver
+    carries it (dicts under ``"n_real"``, the cached path as the second
+    tuple element); 0 when unrecognizable (counts nothing)."""
+    if isinstance(payload, dict):
+        return int(payload.get("n_real", 0))
+    if isinstance(payload, tuple) and len(payload) == 2:
+        return int(payload[1])
+    return 0
+
+
+def _payload_hw(payload):
+    """(h, w) of the dispatched batch when the payload carries pixels
+    (streaming/pipelined paths); cached-index payloads return None and
+    the engine seeds the FLOP plane from its cache shape instead."""
+    if isinstance(payload, dict):
+        raw = payload.get("raw")
+        shape = getattr(raw, "shape", None)
+        if shape is not None and len(shape) == 4:
+            return (int(shape[1]), int(shape[2]))
+    return None
+
+
+class TrainPerf:
+    """Windowed training-performance instruments, riding the deferred-
+    metrics loop (docs/OBSERVABILITY.md "Windows & SLOs").
+
+    Fed exclusively from host-side wall clocks the loop already pays
+    for — inter-dispatch spans and payload ``n_real`` counts — so
+    arming it adds ZERO device fetches and cannot perturb the step
+    program (the compile sentinel pins that). The MFU gauge is pure
+    arithmetic: windowed images/sec × the analytic per-image training
+    FLOPs (models/can.py) over the chip's spec-sheet peak; the HBM
+    gauges read PJRT ``memory_stats()`` once per epoch, ``None``
+    (never 0) on backends without it.
+    """
+
+    def __init__(self, flops_fn=None, peak_tflops=None, clock=None):
+        #: (h, w) -> per-image train-step FLOPs; None disables MFU.
+        self.flops_fn = flops_fn
+        self.peak_tflops = peak_tflops
+        self.step_ms = obswin.WindowedHistogram(clock=clock)
+        self.images = obswin.WindowedCounter(clock=clock)
+        self.mfu = obswin.Gauge()
+        self.hbm_peak = obswin.Gauge()
+        self.hbm_limit = obswin.Gauge()
+        self._lock = threading.Lock()
+        self._flops_per_image: Optional[float] = None  # guarded-by: self._lock
+
+    def seed_flops(self, h: int, w: int) -> None:
+        """Memoize the per-image FLOP figure for plane (h, w) — first
+        caller wins (one training run has one image plane)."""
+        if self.flops_fn is None:
+            return
+        with self._lock:
+            if self._flops_per_image is None:
+                self._flops_per_image = float(self.flops_fn(h, w))
+
+    def note_step(self, dt_s: float, n_images: int, hw=None) -> None:
+        """One dispatched step: ``dt_s`` host wall time since the
+        previous dispatch, ``n_images`` real rows, ``hw`` the image
+        plane (memoized into the per-image FLOP figure)."""
+        self.step_ms.record(dt_s * 1e3)
+        if n_images > 0:
+            self.images.add(n_images)
+        if hw is not None:
+            self.seed_flops(int(hw[0]), int(hw[1]))
+
+    def images_per_sec(self) -> float:
+        return self.images.rate(obswin.DEFAULT_WINDOW_SEC)
+
+    def update_gauges(self, device=None) -> None:
+        """Epoch-boundary refresh: live MFU from the windowed rate, HBM
+        high-water from the device (when it reports one)."""
+        with self._lock:
+            fpi = self._flops_per_image
+        if fpi and self.peak_tflops:
+            ips = self.images_per_sec()
+            if ips > 0:
+                self.mfu.set(ips * fpi / 1e12 / self.peak_tflops)
+        if device is not None:
+            peak = obsdevice.hbm_peak_bytes(device)
+            if peak is not None:
+                self.hbm_peak.set(peak)
+            limit = obsdevice.hbm_limit_bytes(device)
+            if limit is not None:
+                self.hbm_limit.set(limit)
+
+    def epoch_snapshot(self) -> dict:
+        """The per-epoch perf row (train.py --perf-csv and the bench
+        host-fed contract line): windowed step-time quantiles and
+        throughput, live MFU, HBM peak — None where unmeasurable."""
+        steps = self.step_ms.merged(obswin.DEFAULT_WINDOW_SEC)
+        return {
+            "step_ms_p50": round(steps.quantile(0.50), 3),
+            "step_ms_p99": round(steps.quantile(0.99), 3),
+            "images_per_sec_window": round(self.images_per_sec(), 3),
+            "mfu_live": (
+                round(self.mfu.last(), 5)
+                if self.mfu.last() is not None else None
+            ),
+            "hbm_peak_bytes": (
+                int(self.hbm_peak.peak())
+                if self.hbm_peak.peak() is not None else None
+            ),
+        }
+
+
 class TrainingEngine:
     def __init__(
         self,
@@ -287,6 +402,21 @@ class TrainingEngine:
         # Host mirror of state.step: checkpoint cadence and fault-injection
         # keys need the global step every batch without a device sync.
         self._host_step = 0
+        # Windowed perf instruments (host-clock fed; see TrainPerf). The
+        # analytic FLOP model matches the trained network: student
+        # fwd+bwd (+frozen teacher fwd) under distillation, WaterNet
+        # fwd+bwd otherwise.
+        if config.distill:
+            _flops_fn = lambda h, w: train_flops_per_image(  # noqa: E731
+                h, w, config.student_width, config.student_depth,
+                distill=True,
+            )
+        else:
+            _flops_fn = lambda h, w: 3 * waternet_forward_flops(h, w)  # noqa: E731
+        self.perf = TrainPerf(
+            flops_fn=_flops_fn,
+            peak_tflops=obsdevice.peak_tflops(jax.devices()[0]),
+        )
         self._compile_steps()
 
     # ------------------------------------------------------------------
@@ -994,6 +1124,11 @@ class TrainingEngine:
             )
         base_rng = jax.random.PRNGKey(self.config.seed + 1)
         n = self._cache_raw.shape[0]
+        # Index payloads carry no pixels; seed the MFU plane from the
+        # cache shape (host metadata — no fetch).
+        self.perf.seed_flops(
+            int(self._cache_raw.shape[1]), int(self._cache_raw.shape[2])
+        )
 
         def payloads():
             batches = self._cached_index_batches(n, epoch, self.config.shuffle)
@@ -1242,6 +1377,7 @@ class TrainingEngine:
             if sentinel is not None:
                 snapshot = self._host_state_copy()
 
+        t_prev = None
         for count, payload in payloads:
             # Per-step host span, riding the loop exactly like the
             # heartbeat below: dispatch is asynchronous, so this times
@@ -1255,6 +1391,19 @@ class TrainingEngine:
                     time.perf_counter(),
                     args={"batch": count, "step": self._host_step},
                 )
+            if obswin.enabled():
+                # Windowed step time = inter-dispatch wall span. At
+                # steady state the host is backpressured by the device
+                # queue, so this tracks real step time without fetching
+                # anything; first iteration has no span yet.
+                t_now = time.perf_counter()
+                if t_prev is not None:
+                    self.perf.note_step(
+                        t_now - t_prev,
+                        _payload_images(payload),
+                        hw=_payload_hw(payload),
+                    )
+                t_prev = t_now
             if control is None:
                 continue
             if control.heartbeat is not None:
@@ -1271,6 +1420,11 @@ class TrainingEngine:
                 verify()
                 control.checkpoint(count + 1, fetched)
         verify()  # fetch after the epoch; no per-step syncs
+        if obswin.enabled():
+            # Epoch-boundary gauge refresh: MFU is windowed-rate
+            # arithmetic; memory_stats() is a PJRT client query, not an
+            # array fetch — the deferred-metrics discipline holds.
+            self.perf.update_gauges(jax.devices()[0])
         sums = {k: 0.0 for k in TRAIN_METRICS_NAMES}
         for m in fetched:
             for k in sums:
