@@ -76,6 +76,17 @@ faults, with recovery time (quarantine -> re-warm -> reintegrate),
 retried / downgraded / shed counts, and an ``accounted`` cross-check of
 the client-side ledger against the server's ``/stats``.
 
+``--config serve_fleet`` measures process-level fault tolerance behind
+the front router (docs/SERVING.md "Fleet"): a supervised multi-process
+``waternet-serve`` fleet while one worker is SIGKILLed and another's
+event loop is wedged mid-run on deterministic per-worker fault ordinals
+— ``fleet_images_per_sec`` is the sustained throughput THROUGH the
+process failures, with the relaunch recovery time, restart/re-dispatch
+counts, SLO-driven scale/brown-out events, byte-identity of every
+answer against an unfaulted control fleet, and an exact per-worker
+reconciliation of the client's ``X-Worker-Id`` ledger against the
+router's relay ledger (``accounted``).
+
 ``--config tiers`` measures the per-request quality-tier A/B
 (docs/SERVING.md "Quality tiers"): one tier-routing batcher serves the
 same mixed-resolution stream through the full WaterNet pipeline and then
@@ -814,6 +825,239 @@ def bench_serving_chaos(
         "buckets": ladder.describe(),
         "compiles": summary["compiles"],
         "warmup_sec": round(warmup_s, 1),
+        "concurrency": concurrency,
+        "requests": n_req,
+        "n_images": n_images,
+        "max_batch": max_batch,
+    }
+
+
+def bench_serving_fleet(
+    n_images=None, max_batch=None, max_buckets=None, base_hw=None,
+    concurrency=None, requests=None, workers=3,
+    crash_at=None, hang_at=None,
+):
+    """Fleet-router chaos bench (docs/SERVING.md "Fleet"): a supervised
+    ``workers``-process serving fleet behind the front router, driven by
+    the closed-loop load generator while a deterministic fault plan
+    SIGKILLs one worker's process on its ``crash_at``-th request arrival
+    (``gateway_crash``) and wedges another worker's event loop on its
+    ``hang_at``-th (``gateway_hang``) mid-run. The contract line reports
+    sustained throughput THROUGH the process failures
+    (``fleet_images_per_sec``), the detect -> relaunch -> ready recovery
+    time, restart/re-dispatch counts, any SLO-driven scale/brown-out
+    events, ``byte_identical`` — every 200 of the chaos run compared
+    against an unfaulted control fleet's answer for the same payload —
+    and ``accounted``: the client's per-``X-Worker-Id`` ledger
+    reconciled EXACTLY against the router's own per-worker relay ledger
+    (``/stats``), so a silently dropped, double-served, or misattributed
+    request reads ``accounted: false``.
+
+    Workers are real ``waternet-serve`` processes on a throwaway
+    checkpoint, forced onto the host platform (``JAX_PLATFORMS=cpu``,
+    one replica each — the multi-process accelerator constraint, same
+    rationale as the train_chaos bench): the machinery under test is the
+    router, not the chips, so the line is hardware-independent; the
+    parent still owns the relay fail-line for unreachable-tunnel
+    environments.
+    """
+    import shutil
+    import sys
+    import tempfile
+    from pathlib import Path
+
+    import cv2
+
+    from waternet_tpu.serving import derive_buckets
+    from waternet_tpu.serving.fleet import FleetRouter
+    from waternet_tpu.serving.loadgen import run_load
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    n_images = (
+        _env_int("WATERNET_BENCH_FLEET_IMAGES", 24)
+        if n_images is None else n_images
+    )
+    max_batch = (
+        _env_int("WATERNET_BENCH_FLEET_BATCH", 4)
+        if max_batch is None else max_batch
+    )
+    max_buckets = (
+        _env_int("WATERNET_BENCH_SERVE_BUCKETS", 3)
+        if max_buckets is None else max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+    concurrency = (
+        _env_int("WATERNET_BENCH_SERVE_CONCURRENCY", 2 * max_batch)
+        if concurrency is None else concurrency
+    )
+    n_req = (
+        _env_int("WATERNET_BENCH_SERVE_REQUESTS", 2 * n_images)
+        if requests is None else requests
+    )
+    crash_at = (
+        _env_int("WATERNET_BENCH_FLEET_CRASH_AT", 3)
+        if crash_at is None else crash_at
+    )
+    hang_at = crash_at + 2 if hang_at is None else hang_at
+    warmup_budget = _env_int("WATERNET_BENCH_FLEET_WARMUP", 600)
+
+    images, shapes = _serving_population(n_images, base)
+    ladder = derive_buckets(shapes, max_buckets=max_buckets)
+    payloads = [
+        cv2.imencode(".png", im[:, :, ::-1])[1].tobytes() for im in images
+    ]
+
+    tmp = Path(tempfile.mkdtemp(prefix="waternet-fleet-bench-"))
+    try:
+        weights = save_weights(_serving_params(), tmp / "weights.npz")
+        worker_cmd = [
+            sys.executable, "-m", "waternet_tpu.serving.server",
+            "--weights", str(weights),
+            "--serve-buckets", ",".join(ladder.describe()),
+            "--max-batch", str(max_batch),
+            "--max-wait-ms", "5",
+            "--serve-replicas", "1",
+            "--max-queue", str(8 * max_batch),
+        ]
+        worker_env = {"JAX_PLATFORMS": "cpu"}
+        shared = dict(
+            worker_env=worker_env, startup_grace_sec=float(warmup_budget),
+            heartbeat_sec=0.25, poll_sec=0.05, health_poll_sec=0.25,
+            port=0,
+        )
+
+        # Unfaulted 1-worker control fleet: the byte-identity reference
+        # for every payload, THROUGH the router (so the relay itself is
+        # part of what must be byte-exact).
+        router = FleetRouter(
+            worker_cmd, n_workers=1,
+            heartbeat_root=tmp / "control-hb", **shared,
+        )
+        t0 = time.perf_counter()
+        router.start_background()
+        try:
+            router.wait_ready(timeout=warmup_budget)
+            warmup_s = time.perf_counter() - t0
+            control = run_load(
+                router.url, payloads, concurrency=1, total=len(payloads),
+                keep_bodies=True,
+            )
+        finally:
+            router.request_drain()
+            router.join()
+        expected = {
+            i: body for i, status, body in control["bodies"] if status == 200
+        }
+
+        # Chaos fleet: worker slot 0 gen 0 SIGKILLed on its crash_at-th
+        # /enhance arrival, slot 1 gen 0 wedged on its hang_at-th; both
+        # slots must relaunch as fresh generations while the survivors
+        # absorb the re-dispatched traffic.
+        faults = {
+            (0, 0): f"gateway_crash@{crash_at}",
+            (1, 0): f"gateway_hang@{hang_at}",
+        }
+        router = FleetRouter(
+            worker_cmd, n_workers=workers, max_workers=workers + 1,
+            worker_faults=faults, heartbeat_root=tmp / "chaos-hb",
+            late_sec=2.0, hang_sec=4.0, drain_grace_sec=2.0,
+            route_retries=workers, proxy_timeout_sec=60.0,
+            slo="p99_ms<=500,error_rate<=0.05",
+            slo_short_sec=5.0, slo_long_sec=20.0, slo_hold_sec=30.0,
+            scale_cooldown_sec=5.0, backoff_base_sec=0.1,
+            backoff_cap_sec=0.5, **shared,
+        )
+        t0 = time.perf_counter()
+        router.start_background()
+        try:
+            router.wait_ready(timeout=warmup_budget, min_ready=workers)
+            chaos_warmup_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            loaded = run_load(
+                router.url, payloads, concurrency=concurrency, total=n_req,
+                keep_bodies=True, per_worker=True,
+            )
+            chaos_s = time.perf_counter() - t0
+            # Recovery: both faulted slots must come back as ready fresh
+            # generations (the processes aren't actually sick — a real
+            # fleet recovers in one relaunch).
+            deadline = time.monotonic() + 120.0
+            recovered = False
+            while time.monotonic() < deadline:
+                fleet = router.summary()["fleet"]
+                if fleet["ready"] >= workers and fleet["restarts"] >= 2:
+                    recovered = True
+                    break
+                time.sleep(0.1)
+            summary = router.summary()
+            router.request_drain()
+            drain_rc = router.join()
+        except BaseException:
+            router.request_drain()
+            router.join()
+            raise
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    fleet = summary["fleet"]
+    identity = (
+        len(expected) == len(payloads)
+        and loaded["ok"] > 0
+        and all(
+            body == expected[i % len(payloads)]
+            for i, status, body in loaded["bodies"]
+            if status == 200
+        )
+    )
+    # Exact two-sided per-worker reconciliation: every worker the CLIENT
+    # credited must match the router's relay ledger for that worker id,
+    # and every worker the ROUTER credited must match the client — one
+    # request served twice (or attributed to a dead generation) breaks
+    # the equality from one side or the other.
+    ledger = fleet["per_worker"]
+    client_pw = loaded["per_worker"]
+    pw_exact = all(
+        ledger.get(wid, {}).get(key, 0) == bucket.get(key, 0)
+        for wid, bucket in client_pw.items()
+        if wid != "unattributed"
+        for key in ("ok", "shed", "deadline_expired")
+    ) and all(
+        counts.get("ok", 0) == client_pw.get(wid, {}).get("ok", 0)
+        for wid, counts in ledger.items()
+    )
+    accounted = (
+        pw_exact
+        and loaded["errors"] == 0
+        and loaded["conn_reset"] == 0
+        and "unattributed" not in client_pw
+        and sum(c.get("ok", 0) for c in ledger.values()) == loaded["ok"]
+    )
+    return {
+        "metric": "fleet_images_per_sec",
+        "value": round(loaded["ok"] / chaos_s, 2) if chaos_s else 0.0,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "workers": workers,
+        "faults": f"gateway_crash@{crash_at}(w0g0),"
+                  f"gateway_hang@{hang_at}(w1g0)",
+        "restarts": fleet["restarts"],
+        "redispatches": fleet["redispatches"],
+        "recovered": bool(recovered),
+        "recovery_sec": fleet["recovery_sec_max"],
+        "scale_events": fleet["scale_events"],
+        "brownout": fleet["brownout"],
+        "byte_identical": bool(identity),
+        "accounted": bool(accounted),
+        "per_worker": client_pw,
+        "drained_clean": drain_rc == 0,
+        "shed_count": loaded["shed"],
+        "deadline_expired": loaded["deadline_expired"],
+        "conn_reset": loaded["conn_reset"],
+        "errors": loaded["errors"],
+        "p99_ms": loaded["latency_ms"]["p99"],
+        "buckets": ladder.describe(),
+        "warmup_sec": round(warmup_s, 1),
+        "chaos_warmup_sec": round(chaos_warmup_s, 1),
         "concurrency": concurrency,
         "requests": n_req,
         "n_images": n_images,
@@ -1843,7 +2087,8 @@ def main():
     parser.add_argument(
         "--config",
         choices=["train", "video", "serve", "serve_multi", "serve_http",
-                 "serve_chaos", "train_chaos", "tiers", "stream", "obs"],
+                 "serve_chaos", "serve_fleet", "train_chaos", "tiers",
+                 "stream", "obs"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
@@ -1855,6 +2100,11 @@ def main():
         "serve_chaos (closed-loop throughput with one replica crashed "
         "and one hung mid-run: recovery time, retry/downgrade/shed "
         "accounting — docs/SERVING.md 'Fault isolation'), "
+        "serve_fleet (a supervised multi-process serving fleet behind "
+        "the front router with one worker SIGKILLed and one hung "
+        "mid-run: relaunch recovery time, byte-identity vs an unfaulted "
+        "control, exact client-vs-router per-worker accounting, scale "
+        "events — docs/SERVING.md 'Fleet'), "
         "train_chaos (a supervised multi-process training job with one "
         "worker killed and one hung mid-run: restart count, recovery "
         "time, steps lost, and byte-exactness vs an uninterrupted "
@@ -1884,6 +2134,7 @@ def main():
         "serve_multi": "mixed_res_dir_images_per_sec_multidev",
         "serve_http": "http_images_per_sec",
         "serve_chaos": "chaos_images_per_sec",
+        "serve_fleet": "fleet_images_per_sec",
         "train_chaos": "chaos_train_images_per_sec",
         "tiers": "fast_tier_images_per_sec",
         "stream": "video_stream_fps",
@@ -1978,6 +2229,10 @@ def main():
 
     if args.config == "serve_chaos":
         print(json.dumps(bench_serving_chaos()))
+        return
+
+    if args.config == "serve_fleet":
+        print(json.dumps(bench_serving_fleet()))
         return
 
     if args.config == "train_chaos":

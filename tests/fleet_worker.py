@@ -1,0 +1,244 @@
+"""Stub serving worker for the fleet-router tests.
+
+Honors exactly the worker surface the fleet router depends on —
+``/healthz`` / ``/stats`` / ``/enhance`` / ``/stream`` /
+``/admin/policy``, heartbeats via the supervisor env contract, the
+deterministic ``gateway_crash@K`` / ``gateway_hang@K`` fault hook, and
+the ``X-Request-Id`` / ``X-Worker-Id`` stamps — with no jax, no model,
+and millisecond startup, so tests/test_fleet.py can drill failover,
+relaunch, pinning, and policy pushes in well under a second per case.
+
+The "enhancement" is ``bytes(255 - b)`` (deterministic and
+position-independent), so byte-identity across a failover hop is
+checkable without weights: every healthy generation of every slot
+computes the same answer, which is exactly the replica-invariance
+property the real fleet relies on.
+
+Run: ``python tests/fleet_worker.py --host 127.0.0.1 --port N``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import struct
+import sys
+import time
+from pathlib import Path
+
+# Run directly as a script (`python tests/fleet_worker.py`), sys.path[0]
+# is tests/ — the package lives one level up.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from waternet_tpu.resilience import faults  # noqa: E402
+from waternet_tpu.resilience.heartbeat import (  # noqa: E402
+    ENV_WORKER_GENERATION,
+    ENV_WORKER_ID,
+    ENV_WORKER_SLOT,
+    HeartbeatWriter,
+)
+
+_FRAME_LEN = struct.Struct("!I")
+
+
+def transform(payload: bytes) -> bytes:
+    return bytes(255 - b for b in payload)
+
+
+class StubWorker:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self.worker_id = os.environ.get(ENV_WORKER_ID, "")
+        self.requests = 0
+        self.downgrade_watermark = 6  # pretend baseline
+        self._stop = asyncio.Event()
+
+    def _ident(self):
+        return (
+            (("X-Worker-Id", self.worker_id),) if self.worker_id else ()
+        )
+
+    async def main(self) -> int:
+        loop = asyncio.get_running_loop()
+        loop.add_signal_handler(signal.SIGTERM, self._stop.set)
+        server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        print(
+            f"fleet_worker {self.worker_id}: listening on "
+            f"{self.host}:{self.port}",
+            flush=True,
+        )
+        hb = HeartbeatWriter.resolve(
+            process_id=int(os.environ.get(ENV_WORKER_SLOT, "0") or 0),
+            generation=int(os.environ.get(ENV_WORKER_GENERATION, "0") or 0),
+        )
+        beat_task = None
+        if hb is not None:
+            # Unlike the real worker there is no warmup: serving starts
+            # the moment the socket binds, so the FIRST beat is already
+            # serve-phase — the router's hang detector arms immediately
+            # (a wedge on the very first request must not hide behind
+            # the startup grace).
+            hb.beat(phase="serve", force=True)
+
+            async def _beats():
+                while True:
+                    hb.beat(step=self.requests, phase="serve")
+                    await asyncio.sleep(hb.min_interval_sec / 2)
+
+            beat_task = loop.create_task(_beats())
+        try:
+            await self._stop.wait()
+        finally:
+            if beat_task is not None:
+                beat_task.cancel()
+            server.close()
+            await server.wait_closed()
+        return 0
+
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or not line.strip():
+                    break
+                method, target = line.decode("latin-1").split()[:2]
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if not line or line in (b"\r\n", b"\n"):
+                        break
+                    name, _, value = line.decode("latin-1").partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                length = int(headers.get("content-length", "0") or 0)
+                body = await reader.readexactly(length) if length else b""
+                path = target.split("?", 1)[0]
+                if path == "/stream":
+                    await self._stream(headers, reader, writer)
+                    break
+                keep = self._dispatch(method, path, headers, body, writer)
+                await writer.drain()
+                if not keep or headers.get("connection") == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _respond(self, writer, status, body, extra=(), ctype="application/json"):
+        reason = {200: "OK", 404: "Not Found", 429: "Too Many Requests",
+                  503: "Service Unavailable"}.get(status, "X")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+        )
+        for name, value in extra:
+            head += f"{name}: {value}\r\n"
+        writer.write(head.encode("latin-1") + b"\r\n" + body)
+        return True
+
+    def _dispatch(self, method, path, headers, body, writer):
+        rid = headers.get("x-request-id", "")
+        ident = (("X-Request-Id", rid),) + self._ident()
+        if path == "/healthz":
+            return self._respond(
+                writer, 200,
+                json.dumps(
+                    {"ready": True, "worker_id": self.worker_id}
+                ).encode(),
+            )
+        if path == "/stats":
+            return self._respond(
+                writer, 200,
+                json.dumps({
+                    "requests": self.requests,
+                    "queue_depth": 0,
+                    "replicas": 1,
+                    "latency_ms_window": {"p50": 1.0, "p99": 2.0},
+                }).encode(),
+            )
+        if path == "/admin/policy":
+            payload = json.loads(body or b"{}")
+            if "downgrade_watermark" in payload:
+                self.downgrade_watermark = payload["downgrade_watermark"]
+            return self._respond(
+                writer, 200,
+                json.dumps({
+                    "policy": {
+                        "downgrade_watermark": self.downgrade_watermark,
+                        "admit_watermark": 8,
+                    }
+                }).encode(),
+            )
+        if path in ("/enhance", "/v1/enhance"):
+            # Same hook placement as the real worker: the K-th ARRIVAL,
+            # before any answer bytes, can kill or wedge this process.
+            gate = faults.gateway_fault()
+            if gate.crash:
+                os.kill(os.getpid(), signal.SIGKILL)
+            if gate.hang is not None:
+                gate.hang.wait()  # wedges the event loop on purpose
+            self.requests += 1
+            if body == b"SHED":
+                return self._respond(
+                    writer, 429, json.dumps({"error": "shedding"}).encode(),
+                    extra=(("Retry-After", "7"),) + ident,
+                )
+            if body == b"SLOW":
+                time.sleep(0.35)  # blocks the loop: per-attempt timeout bait
+            return self._respond(
+                writer, 200, transform(body),
+                ctype="application/octet-stream",
+                extra=ident + (("X-Tier-Served", "stub"),),
+            )
+        return self._respond(
+            writer, 404, json.dumps({"error": "no route"}).encode(),
+            extra=ident,
+        )
+
+    async def _stream(self, headers, reader, writer):
+        rid = headers.get("x-request-id", "")
+        head = (
+            "HTTP/1.1 200 OK\r\n"
+            "Content-Type: application/x-waternet-stream\r\n"
+            f"X-Request-Id: {rid}\r\n"
+        )
+        if self.worker_id:
+            head += f"X-Worker-Id: {self.worker_id}\r\n"
+        head += "Connection: close\r\n\r\n"
+        writer.write(head.encode("latin-1"))
+        await writer.drain()
+        while True:
+            raw = await reader.readexactly(_FRAME_LEN.size)
+            (n,) = _FRAME_LEN.unpack(raw)
+            if n == 0:
+                break
+            payload = await reader.readexactly(n)
+            out = transform(payload)
+            writer.write(_FRAME_LEN.pack(len(out)) + out)
+            await writer.drain()
+        writer.write(_FRAME_LEN.pack(0))
+        await writer.drain()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", type=str, default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    args, _ = parser.parse_known_args(argv)
+    faults.install_from_env()
+    return asyncio.run(StubWorker(args.host, args.port).main())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,594 @@
+"""Fleet router tests (waternet_tpu/serving/fleet.py, docs/SERVING.md
+"Fleet").
+
+Three layers, cheapest first:
+
+* **Pure units** — :class:`HashRing` isolation properties (uniform
+  spread, single-arc remap on death, fixed mapping pins so membership
+  behavior is deterministic forever) and :class:`FleetPolicy` decision
+  logic, no processes, no clocks.
+* **Deterministic control loop** — a non-started router driven entirely
+  by a fake clock: sustained ``page`` burn provably triggers the
+  brown-out and a scale-up event, sustained ``ok`` restores — no
+  sleeps-as-synchronization anywhere.
+* **Integration** — a real router supervising stub workers
+  (tests/fleet_worker.py: the worker HTTP surface, heartbeats, and the
+  deterministic ``gateway_crash@K``/``gateway_hang@K`` hook, minus jax),
+  drilling failover byte-identity with the request id preserved, verdict
+  relays (``Retry-After`` pass-through), stream pinning, per-worker
+  accounting reconciliation, policy pushes, and clean drain.
+
+Module-wide ``locktrace``: every lock the router and the loadgen create
+during these tests is watched for cycle-forming acquisition orders.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import struct
+import sys
+import time
+from collections import Counter
+from pathlib import Path
+from urllib.parse import urlparse
+
+import pytest
+
+from waternet_tpu.serving.fleet import (
+    FleetPolicy,
+    FleetRouter,
+    HashRing,
+    render_fleet_prometheus,
+    worker_id,
+)
+
+pytestmark = pytest.mark.usefixtures("locktrace")
+
+STUB = Path(__file__).resolve().parent / "fleet_worker.py"
+_FRAME_LEN = struct.Struct("!I")
+
+
+def transform(payload: bytes) -> bytes:
+    """The stub worker's deterministic 'enhancement'."""
+    return bytes(255 - b for b in payload)
+
+
+# ---------------------------------------------------------------------------
+# HashRing isolation
+# ---------------------------------------------------------------------------
+
+
+def test_ring_uniform_spread():
+    ring = HashRing()
+    for slot in range(4):
+        ring.add(slot)
+    counts = Counter(ring.lookup(f"k{i}") for i in range(10_000))
+    assert set(counts) == {0, 1, 2, 3}
+    for slot, n in counts.items():
+        share = n / 10_000
+        assert 0.10 <= share <= 0.45, (
+            f"slot {slot} owns {share:.1%} of keys — not a usable spread"
+        )
+
+
+def test_ring_single_arc_remap_on_death():
+    ring = HashRing()
+    for slot in range(4):
+        ring.add(slot)
+    keys = [f"k{i}" for i in range(2_000)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove(2)
+    after = {k: ring.lookup(k) for k in keys}
+    moved = {k for k in keys if before[k] != after[k]}
+    # Exactly the dead worker's sessions move — nobody else's.
+    assert moved == {k for k in keys if before[k] == 2}
+    assert all(after[k] != 2 for k in moved)
+    # Rejoin restores the original mapping exactly (vnode points are
+    # pure functions of the slot id — no process randomness anywhere).
+    ring.add(2)
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_ring_fixed_mapping_pin():
+    """Membership-change behavior must be deterministic in tests, so the
+    mapping itself is pinned: these assignments are sha256 facts and may
+    only change if the ring's hashing scheme changes (which would remap
+    every pinned session in production — a breaking change to call out,
+    not to discover)."""
+    ring4 = HashRing()
+    for slot in range(4):
+        ring4.add(slot)
+    assert {k: ring4.lookup(k) for k in (
+        "session-a", "session-b", "session-c", "cam-0", "cam-1",
+        "lg-x-00001",
+    )} == {
+        "session-a": 2, "session-b": 0, "session-c": 3,
+        "cam-0": 0, "cam-1": 2, "lg-x-00001": 1,
+    }
+    ring2 = HashRing()
+    ring2.add(0)
+    ring2.add(1)
+    assert {k: ring2.lookup(k) for k in ("s1", "s2", "s3", "s4")} == {
+        "s1": 0, "s2": 1, "s3": 1, "s4": 0,
+    }
+
+
+def test_ring_empty_and_vnode_validation():
+    assert HashRing().lookup("anything") is None
+    with pytest.raises(ValueError):
+        HashRing(vnodes=0)
+
+
+# ---------------------------------------------------------------------------
+# FleetPolicy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_page_browns_out_then_scales_up():
+    p = FleetPolicy(2, 4, cooldown_sec=30.0)
+    assert p.step(0.0, "page", 2) == ["brownout", "scale_up"]
+    # Cooldown holds further scaling; brown-out is already active.
+    assert p.step(1.0, "page", 3) == []
+    assert p.step(40.0, "page", 3) == ["scale_up"]
+    # At the ceiling, paging can only hold the brown-out.
+    assert p.step(80.0, "page", 4) == []
+
+
+def test_policy_ok_restores_then_scales_down():
+    p = FleetPolicy(2, 4, cooldown_sec=30.0)
+    p.step(0.0, "page", 2)
+    assert p.step(40.0, "ok", 3) == ["restore", "scale_down"]
+    assert p.step(41.0, "ok", 2) == []  # cooldown + at the floor
+    assert p.brownout is False
+
+
+def test_policy_warn_holds_position():
+    p = FleetPolicy(1, 4, cooldown_sec=0.0)
+    assert p.step(0.0, "warn", 2) == []
+    p.step(1.0, "page", 2)
+    assert p.brownout
+    assert p.step(2.0, "warn", 3) == []  # neither restore nor scale
+    assert p.brownout
+
+
+def test_policy_bounds_validated():
+    with pytest.raises(ValueError):
+        FleetPolicy(3, 2)
+    with pytest.raises(ValueError):
+        FleetPolicy(0, 2)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic SLO closed loop (fake clock, no processes, no sleeps)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _control_router(tmp_path, clock):
+    return FleetRouter(
+        [sys.executable, "-c", "raise SystemExit(0)"],
+        n_workers=1,
+        max_workers=3,
+        slo="error_rate<=0.05",
+        slo_short_sec=5.0,
+        slo_long_sec=30.0,
+        slo_hold_sec=10.0,
+        scale_cooldown_sec=10.0,
+        heartbeat_root=tmp_path,
+        clock=clock,
+    )
+
+
+def test_sustained_page_burn_triggers_scale_up_and_brownout(
+    tmp_path, monkeypatch
+):
+    clock = FakeClock()
+    router = _control_router(tmp_path, clock)
+    spawned = []
+    pushed = []
+    monkeypatch.setattr(
+        router, "_spawn_worker",
+        lambda slot, gen: spawned.append((slot, gen)),
+    )
+    monkeypatch.setattr(
+        router, "_apply_policy",
+        lambda w, wm: pushed.append((w.worker_id, wm)),
+    )
+    # 100% errors for five seconds of relays: short AND long burn blow
+    # past the page threshold — a sustained burn, not a blip.
+    for t in range(5):
+        clock.t = float(t)
+        for _ in range(8):
+            router._windows.observe(500, 100.0)
+    clock.t = 5.0
+    router._control_tick(clock.t)
+    events = {e["event"]: e for e in router.summary()["fleet"]["events"]}
+    assert "brownout" in events and "scale_up" in events
+    # Every transition names its triggering objective.
+    assert events["scale_up"]["objective"].startswith("error_rate")
+    assert events["brownout"]["objective"].startswith("error_rate")
+    assert spawned == [(1, 0)]  # slots 0..n_workers-1 are the base fleet
+    assert router._policy.brownout
+    # Second tick inside the cooldown: no second spawn, no re-brownout.
+    clock.t = 6.0
+    router._control_tick(clock.t)
+    assert spawned == [(1, 0)]
+
+
+def test_sustained_ok_restores_after_hold(tmp_path, monkeypatch):
+    clock = FakeClock()
+    router = _control_router(tmp_path, clock)
+    monkeypatch.setattr(
+        router, "_spawn_worker", lambda slot, gen: None
+    )
+    pushed = []
+    monkeypatch.setattr(
+        router, "_apply_policy",
+        lambda w, wm: pushed.append((w.worker_id, wm)),
+    )
+    for t in range(5):
+        clock.t = float(t)
+        router._windows.observe(500, 100.0)
+    clock.t = 5.0
+    router._control_tick(clock.t)
+    assert router._policy.brownout
+    # Healthy traffic long enough for the errors to age out of BOTH
+    # windows and for hold_sec of quiet: the loop must de-escalate.
+    restored = False
+    for t in range(6, 70):
+        clock.t = float(t)
+        router._windows.observe(200, 10.0)
+        router._control_tick(clock.t)
+        if not router._policy.brownout:
+            restored = True
+            break
+    assert restored, "ok state never restored the baseline policy"
+    events = [e["event"] for e in router.summary()["fleet"]["events"]]
+    assert "restore" in events
+    slo = router.summary()["slo"]
+    assert slo["state"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Integration against stub workers
+# ---------------------------------------------------------------------------
+
+
+def _start_fleet(**overrides):
+    kw = dict(
+        n_workers=2,
+        poll_sec=0.05,
+        health_poll_sec=0.1,
+        heartbeat_sec=0.1,
+        late_sec=1.0,
+        hang_sec=2.0,
+        startup_grace_sec=60.0,
+        drain_grace_sec=1.0,
+        grace_sec=10.0,
+        backoff_base_sec=0.05,
+        backoff_cap_sec=0.2,
+        port=0,
+    )
+    kw.update(overrides)
+    router = FleetRouter([sys.executable, str(STUB)], **kw)
+    router.start_background()
+    try:
+        router.wait_ready(timeout=60.0)
+    except BaseException:
+        router.request_drain()
+        router.join()
+        raise
+    return router
+
+
+def _request(url, method, path, body=b"", headers=None, timeout=30.0):
+    u = urlparse(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, {k.lower(): v for k, v in resp.getheaders()}, data
+    finally:
+        conn.close()
+
+
+def _get_stats(url):
+    status, _, body = _request(url, "GET", "/stats")
+    assert status == 200
+    return json.loads(body)
+
+
+def _wait(cond, timeout=30.0, what="condition"):
+    """Bounded wait on external subprocess state (never used where a
+    deterministic assertion is possible)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_fleet_end_to_end(tmp_path):
+    router = _start_fleet(heartbeat_root=tmp_path)
+    try:
+        url = router.url
+
+        # -- routing + identity stamps --------------------------------
+        status, headers, body = _request(
+            url, "POST", "/enhance", b"hello fleet",
+            {"X-Request-Id": "e2e-1"},
+        )
+        assert status == 200
+        assert body == transform(b"hello fleet")
+        assert headers["x-request-id"] == "e2e-1"
+        assert headers["x-worker-id"] in (worker_id(0, 0), worker_id(1, 0))
+
+        # -- verdict relays pass Retry-After + ids through verbatim ----
+        status, headers, _ = _request(
+            url, "POST", "/enhance", b"SHED", {"X-Request-Id": "e2e-shed"},
+        )
+        assert status == 429
+        assert headers["retry-after"] == "7"
+        assert headers["x-request-id"] == "e2e-shed"
+        assert headers["x-worker-id"].startswith("w")
+
+        # -- router-side errors echo the request id too ----------------
+        status, headers, _ = _request(
+            url, "GET", "/nope", headers={"X-Request-Id": "e2e-404"},
+        )
+        assert status == 404
+        assert headers["x-request-id"] == "e2e-404"
+
+        # -- per-worker accounting reconciles client vs router ---------
+        from waternet_tpu.serving import loadgen
+
+        before = _get_stats(url)["fleet"]["per_worker"]
+        report = loadgen.run_load(
+            url, [b"abc", b"defgh"], concurrency=3, total=12,
+            per_worker=True, collect_ledger=True,
+        )
+        assert report["ok"] == 12
+        after = _get_stats(url)["fleet"]["per_worker"]
+        for wid, counts in report["per_worker"].items():
+            assert wid != "unattributed"
+            routed = after[wid]["ok"] - before.get(wid, {}).get("ok", 0)
+            assert routed == counts["ok"], (
+                f"client ledger says {counts['ok']} ok from {wid}, "
+                f"router relayed {routed}"
+            )
+        assert sum(c["ok"] for c in report["per_worker"].values()) == 12
+        assert all(
+            e["worker"] in report["per_worker"] for e in report["ledger"]
+        )
+
+        # -- /healthz per-worker map, /stats, /metrics ----------------
+        status, _, body = _request(url, "GET", "/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "ok"
+        assert set(health["workers"]) == {worker_id(0, 0), worker_id(1, 0)}
+        stats = _get_stats(url)
+        assert stats["fleet"]["ready"] == 2
+        assert stats["fleet"]["routed"]["enhance"] >= 14
+        status, _, body = _request(url, "GET", "/metrics")
+        text = body.decode()
+        assert status == 200
+        assert "waternet_fleet_workers 2" in text
+        assert "waternet_fleet_worker_relay_total" in text
+        assert render_fleet_prometheus(stats).startswith("# HELP")
+
+        # -- stream pinning by consistent hash on the session id -------
+        # ring pins (test_ring_fixed_mapping_pin): s1 -> slot 0,
+        # s2 -> slot 1 — asserted against the live X-Worker-Id stamp.
+        for session, slot in (("s1", 0), ("s2", 1)):
+            u = urlparse(url)
+            sock = socket.create_connection(
+                (u.hostname, u.port), timeout=30.0
+            )
+            try:
+                sock.sendall((
+                    "POST /stream HTTP/1.1\r\nHost: x\r\n"
+                    f"X-Request-Id: {session}\r\n\r\n"
+                ).encode())
+                f = sock.makefile("rb")
+                assert b"200" in f.readline()
+                shead = {}
+                while True:
+                    line = f.readline()
+                    if not line or line in (b"\r\n", b"\n"):
+                        break
+                    name, _, value = line.decode().partition(":")
+                    shead[name.strip().lower()] = value.strip()
+                assert shead["x-worker-id"] == worker_id(slot, 0)
+                assert shead["x-request-id"] == session
+                for frame in (b"frame-one", b"frame-two!"):
+                    sock.sendall(_FRAME_LEN.pack(len(frame)) + frame)
+                    (n,) = _FRAME_LEN.unpack(f.read(_FRAME_LEN.size))
+                    assert f.read(n) == transform(frame)
+                sock.sendall(_FRAME_LEN.pack(0))
+                (n,) = _FRAME_LEN.unpack(f.read(_FRAME_LEN.size))
+                assert n == 0  # clean end-of-stream from the worker
+            finally:
+                sock.close()
+        assert _get_stats(url)["fleet"]["routed"]["stream"] == 2
+
+        # -- brown-out policy push + restore ---------------------------
+        w0 = _get_stats(url)["workers"][worker_id(0, 0)]
+        router._apply_brownout(0.0, "manual-test")
+        _, _, pbody = _request(
+            f"http://127.0.0.1:{w0['port']}", "POST", "/admin/policy",
+            b"{}", {"Content-Type": "application/json"},
+        )
+        assert json.loads(pbody)["policy"]["downgrade_watermark"] == 1
+        router._apply_restore(0.0, "manual-test")
+        _, _, pbody = _request(
+            f"http://127.0.0.1:{w0['port']}", "POST", "/admin/policy",
+            b"{}", {"Content-Type": "application/json"},
+        )
+        # The stub's baseline (captured at ready via POST {}) is 6.
+        assert json.loads(pbody)["policy"]["downgrade_watermark"] == 6
+
+        # -- deadline-aware routing: an infeasible budget is refused ---
+        _wait(
+            lambda: all(
+                w.latency_p50_ms for w in router._workers.values()
+            ),
+            what="worker latency gauges",
+        )
+        status, headers, body = _request(
+            url, "POST", "/enhance", b"x",
+            {"X-Request-Id": "e2e-ddl", "X-Deadline-Ms": "0.001"},
+        )
+        assert status == 504
+        assert headers["x-request-id"] == "e2e-ddl"
+        assert b"deadline" in body
+    finally:
+        router.request_drain()
+        rc = router.join()
+    assert rc == 0
+
+
+def test_crash_failover_preserves_bytes_and_request_id(tmp_path):
+    """Deterministic fault ordinal: the FIRST /enhance arrival at slot 0
+    (the tie-break winner for the first idle-fleet request) SIGKILLs
+    that worker mid-request. The client must still get the byte-exact
+    answer with its request id, served by the survivor."""
+    router = _start_fleet(
+        heartbeat_root=tmp_path,
+        worker_faults={(0, 0): "gateway_crash@1"},
+    )
+    try:
+        payload = b"crash me once"
+        status, headers, body = _request(
+            router.url, "POST", "/enhance", payload,
+            {"X-Request-Id": "failover-1"},
+        )
+        assert status == 200
+        assert body == transform(payload)  # byte-identical across the hop
+        assert headers["x-request-id"] == "failover-1"
+        assert headers["x-worker-id"] == worker_id(1, 0)  # the survivor
+        stats = _get_stats(router.url)
+        assert stats["fleet"]["redispatches"] >= 1
+
+        # The supervisor relaunches slot 0 as generation 1.
+        _wait(
+            lambda: _get_stats(router.url)["fleet"]["ready"] == 2
+            and worker_id(0, 1) in _get_stats(router.url)["workers"],
+            what="slot 0 relaunch",
+        )
+        stats = _get_stats(router.url)
+        assert stats["fleet"]["restarts"] >= 1
+        events = stats["fleet"]["events"]
+        failed = [e for e in events if e["event"] == "worker_failed"]
+        assert any(e["worker"] == worker_id(0, 0) for e in failed)
+        ready = [
+            e for e in events
+            if e["event"] == "worker_ready" and "recovery_sec" in e
+        ]
+        assert ready and ready[-1]["recovery_sec"] > 0
+        # The relaunched generation serves (fresh fault counter: the
+        # plan was pinned to generation 0 only).
+        status, headers, body = _request(
+            router.url, "POST", "/enhance", b"post-recovery",
+            {"X-Request-Id": "failover-2"},
+        )
+        assert status == 200 and body == transform(b"post-recovery")
+    finally:
+        router.request_drain()
+        rc = router.join()
+    assert rc == 0
+
+
+def test_hang_failover_and_relaunch(tmp_path):
+    """gateway_hang@1 wedges slot 0's event loop on its first /enhance:
+    /healthz, heartbeats, and the open relay freeze together. The
+    per-attempt proxy timeout re-dispatches the in-flight request; the
+    monitor then declares the hang off heartbeat age and relaunches."""
+    router = _start_fleet(
+        heartbeat_root=tmp_path,
+        worker_faults={(0, 0): "gateway_hang@1"},
+        proxy_timeout_sec=0.5,
+        hang_sec=1.5,
+    )
+    try:
+        payload = b"hang in there"
+        t0 = time.monotonic()
+        status, headers, body = _request(
+            router.url, "POST", "/enhance", payload,
+            {"X-Request-Id": "hung-1"},
+        )
+        assert status == 200
+        assert body == transform(payload)
+        assert headers["x-request-id"] == "hung-1"
+        assert headers["x-worker-id"] == worker_id(1, 0)
+        # Re-dispatch happened via the bounded per-attempt timeout, not
+        # by waiting out the hang detector.
+        assert time.monotonic() - t0 < 10.0
+        _wait(
+            lambda: worker_id(0, 1) in _get_stats(router.url)["workers"]
+            and _get_stats(router.url)["fleet"]["ready"] == 2,
+            what="hung worker relaunch",
+        )
+        events = _get_stats(router.url)["fleet"]["events"]
+        hung = [
+            e for e in events
+            if e["event"] == "worker_failed"
+            and e["worker"] == worker_id(0, 0)
+        ]
+        assert hung and hung[0]["reason"] == "heartbeat"
+    finally:
+        router.request_drain()
+        rc = router.join()
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# waternet-trace slo --per-worker (offline attribution)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_slo_per_worker_attributes_the_sick_worker(
+    tmp_path, capsys
+):
+    from waternet_tpu.obs.cli import main as trace_main
+
+    entries = []
+    for i in range(200):
+        entries.append({
+            "t": i * 0.5, "latency_ms": 10.0, "outcome": "ok",
+            "worker": "w0g0",
+        })
+        entries.append({
+            "t": i * 0.5 + 0.1,
+            "latency_ms": None if i % 2 else 10.0,
+            "outcome": "errors" if i % 2 else "ok",
+            "worker": "w1g0",
+        })
+    ledger = tmp_path / "ledger.json"
+    ledger.write_text(json.dumps({"ledger": entries}))
+    rc = trace_main([
+        "slo", str(ledger), "--slo", "error_rate<=0.01", "--per-worker",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1  # the sick worker ends paging
+    assert "[worker w0g0]" in out and "[worker w1g0]" in out
+    assert "workers replayed: 2" in out
+    # Healthy worker alone replays clean.
+    healthy = tmp_path / "healthy.json"
+    healthy.write_text(json.dumps(
+        [e for e in entries if e["worker"] == "w0g0"]
+    ))
+    rc = trace_main([
+        "slo", str(healthy), "--slo", "error_rate<=0.01", "--per-worker",
+    ])
+    assert rc == 0

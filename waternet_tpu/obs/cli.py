@@ -27,7 +27,10 @@ Three modes (docs/OBSERVABILITY.md "Reading a trace" / "Windows & SLOs"):
     (:mod:`waternet_tpu.obs.slo`), printing every ok/warn/page
     transition with its ledger timestamp and the final per-objective
     burn table. Exit 1 when any objective ends paging — usable as a
-    post-hoc gate on a recorded load test.
+    post-hoc gate on a recorded load test. ``--per-worker`` replays
+    each worker's entries separately (fleet ledgers carry the
+    ``X-Worker-Id`` per answer) so one sick worker's burn is
+    attributable offline.
 
 Pure stdlib; never imports jax (safe on hosts without an accelerator).
 """
@@ -38,6 +41,7 @@ import argparse
 import json
 import sys
 from pathlib import Path
+from typing import Dict
 from typing import Dict, List, Optional
 
 from waternet_tpu.resilience.heartbeat import read_heartbeat
@@ -269,17 +273,11 @@ def _load_ledger(path: Path) -> list:
     )
 
 
-def _slo_replay(args, out=None) -> int:
-    out = out or sys.stdout  # bind late: tests capture sys.stdout
-    from waternet_tpu.obs.slo import parse_slo, replay_ledger
+def _replay_one(out, label, entries, objectives, args) -> bool:
+    """Replay one entry group; prints the standard report, returns
+    whether any objective ended in ``page``."""
+    from waternet_tpu.obs.slo import replay_ledger
 
-    path = Path(args.ledger)
-    try:
-        entries = _load_ledger(path)
-        objectives = parse_slo(args.slo)
-    except (OSError, ValueError) as e:
-        print(f"waternet-trace slo: {e}", file=sys.stderr)
-        return 2
     transitions, block = replay_ledger(
         entries,
         objectives,
@@ -290,7 +288,7 @@ def _slo_replay(args, out=None) -> int:
     )
     n = len(entries)
     span = max((float(e.get("t", 0.0)) for e in entries), default=0.0)
-    print(f"slo replay: {n} ledger entries over {span:.1f}s "
+    print(f"slo replay{label}: {n} ledger entries over {span:.1f}s "
           f"(windows {args.short_sec:g}s/{args.long_sec:g}s, "
           f"eval every {args.step_sec:g}s)", file=out)
     if transitions:
@@ -310,6 +308,38 @@ def _slo_replay(args, out=None) -> int:
               file=out)
         paging = paging or row["state"] == "page"
     print(f"grade: {block.get('grade', 'ok')}", file=out)
+    return paging
+
+
+def _slo_replay(args, out=None) -> int:
+    out = out or sys.stdout  # bind late: tests capture sys.stdout
+    from waternet_tpu.obs.slo import parse_slo
+
+    path = Path(args.ledger)
+    try:
+        entries = _load_ledger(path)
+        objectives = parse_slo(args.slo)
+    except (OSError, ValueError) as e:
+        print(f"waternet-trace slo: {e}", file=sys.stderr)
+        return 2
+    if not args.per_worker:
+        paging = _replay_one(out, "", entries, objectives, args)
+        return 1 if paging else 0
+    # Per-worker attribution (docs/SERVING.md "Fleet"): fleet ledgers
+    # carry the X-Worker-Id each answer was stamped with, so replaying
+    # each worker's entries separately shows WHOSE latency/errors burned
+    # the budget — one sick worker is findable offline, after the run.
+    groups: Dict[str, list] = {}
+    for e in entries:
+        groups.setdefault(e.get("worker") or "unattributed", []).append(e)
+    paging = False
+    for worker in sorted(groups):
+        hot = _replay_one(
+            out, f" [worker {worker}]", groups[worker], objectives, args
+        )
+        paging = paging or hot
+        print(file=out)
+    print(f"workers replayed: {len(groups)}", file=out)
     return 1 if paging else 0
 
 
@@ -332,6 +362,11 @@ def build_slo_parser() -> argparse.ArgumentParser:
                    help="sustained burn window")
     p.add_argument("--hold-sec", type=float, default=60.0,
                    help="quiet time required before de-escalation")
+    p.add_argument("--per-worker", action="store_true", default=False,
+                   help="replay each worker's entries separately (fleet "
+                        "ledgers carry X-Worker-Id per answer, "
+                        "waternet-loadgen --per-worker) to attribute a "
+                        "burn to the worker that caused it")
     return p
 
 

@@ -77,6 +77,20 @@ is a set of one-shot events, each keyed by a deterministic counter:
   undecodable, exercising the per-frame quarantine path: that frame
   alone errors, its session and every other stream keep flowing
   (serving/streams.py calls :func:`frame_should_corrupt`).
+* ``gateway_crash@K`` — the K-th ``/enhance`` arrival at THIS serving
+  process (1-based, per-process) self-terminates it HARD (SIGKILL, no
+  drain): the faithful signature of a serving worker OOM-killed with a
+  request in flight. The fleet router (docs/SERVING.md "Fleet") must
+  detect the exit, re-dispatch the in-flight request onto a surviving
+  worker, and relaunch the gateway as a fresh generation
+  (serving/server.py calls :func:`gateway_fault`).
+* ``gateway_hang@K`` — the K-th ``/enhance`` arrival wedges the serving
+  process's event loop on a release latch: ``/healthz`` stops
+  answering, heartbeats stop, and every connection (including the
+  faulted request's) freezes while the process stays alive — a wedged
+  gateway. Releasable like ``proc_hang`` (:func:`clear` /
+  :func:`install` wake it); under the fleet router nothing clears the
+  plan and the worker is SIGKILLed past the drain grace.
 
 Plans come from the environment (``WATERNET_FAULTS="nan@3,sigterm@10"``,
 read once by :func:`install_from_env`, which train.py calls) or from tests
@@ -106,6 +120,7 @@ _ADMIT_CALLS = 0  # guarded-by: _SERVE_LOCK
 _COMPLETE_CALLS = 0  # guarded-by: _SERVE_LOCK
 _STREAM_SESSIONS = 0  # guarded-by: _SERVE_LOCK
 _FRAME_DECODES = 0  # guarded-by: _SERVE_LOCK
+_GATEWAY_CALLS = 0  # guarded-by: _SERVE_LOCK
 _SERVE_LOCK = threading.Lock()
 #: Release latch for armed ``replica_hang`` events: a wedged launch thread
 #: waits on this, and :func:`install` / :func:`clear` set it — so a test
@@ -122,7 +137,7 @@ class FaultPlan:
         "decode",
         "slow_replica", "replica_crash", "replica_hang", "nan_output",
         "reject_admit", "stream_stall", "stream_disconnect",
-        "frame_corrupt",
+        "frame_corrupt", "gateway_crash", "gateway_hang",
     )
 
     def __init__(self, events=()):
@@ -163,7 +178,7 @@ class FaultPlan:
 def install(plan: FaultPlan | None) -> None:
     global _PLAN, _IMREAD_CALLS, _LAUNCH_CALLS, _ADMIT_CALLS
     global _COMPLETE_CALLS, _STREAM_SESSIONS, _FRAME_DECODES
-    global _HANG_RELEASE
+    global _GATEWAY_CALLS, _HANG_RELEASE
     with _SERVE_LOCK:
         # Release any launch thread wedged by the PREVIOUS plan's
         # replica_hang before swapping latches: hangs are releasable by
@@ -180,6 +195,7 @@ def install(plan: FaultPlan | None) -> None:
         _COMPLETE_CALLS = 0
         _STREAM_SESSIONS = 0
         _FRAME_DECODES = 0
+        _GATEWAY_CALLS = 0
     with _IMREAD_LOCK:
         _IMREAD_CALLS = 0
 
@@ -417,6 +433,44 @@ def frame_should_corrupt() -> bool:
     with _SERVE_LOCK:
         _FRAME_DECODES += 1
         return _PLAN.fire("frame_corrupt", _FRAME_DECODES)
+
+
+class GatewayFault(NamedTuple):
+    """What the K-th ``/enhance`` arrival at this serving process should
+    do (one per-process counter, two kinds sharing the ordinal).
+    ``crash`` means SIGKILL self before answering; ``hang`` is None, or
+    the release :class:`threading.Event` the armed plan owns — the
+    handler blocks the event loop thread on it, freezing ``/healthz``
+    and heartbeats together, which is exactly the signature the fleet
+    router's hang detection exists to catch."""
+
+    crash: bool
+    hang: "threading.Event | None"
+
+
+_NO_GATEWAY_FAULT = GatewayFault(False, None)
+
+
+def gateway_fault() -> GatewayFault:
+    """Hook run once per ``/enhance`` arrival at the HTTP front door
+    (waternet_tpu/serving/server.py), before admission.
+
+    Keyed by a per-process arrival counter under a lock (kinds
+    ``gateway_crash`` and ``gateway_hang`` share the ordinal: the K-th
+    enhance request THIS worker sees). Arrivals 1..K-1 are answered
+    normally, so a fleet bench can pin exactly which in-flight request
+    the failover must re-dispatch. With no plan installed this is a
+    single ``is None`` check.
+    """
+    global _GATEWAY_CALLS
+    if _PLAN is None:
+        return _NO_GATEWAY_FAULT
+    with _SERVE_LOCK:
+        _GATEWAY_CALLS += 1
+        k = _GATEWAY_CALLS
+        crash = _PLAN.fire("gateway_crash", k)
+        hang = _HANG_RELEASE if _PLAN.fire("gateway_hang", k) else None
+    return GatewayFault(crash, hang)
 
 
 def after_checkpoint_save(path, ordinal: int) -> None:

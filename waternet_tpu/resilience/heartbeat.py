@@ -44,6 +44,14 @@ ENV_HEARTBEAT_DIR = "WATERNET_HEARTBEAT_DIR"
 #: Emission throttle (seconds between records; beats inside the window are
 #: a no-op comparison).
 ENV_HEARTBEAT_SEC = "WATERNET_HEARTBEAT_SEC"
+#: Fleet-router -> serving-worker identity contract
+#: (waternet_tpu.serving.fleet): the slot index and restart generation a
+#: worker writes into its heartbeat records, and the opaque worker id it
+#: stamps on every response as ``X-Worker-Id`` so client ledgers can
+#: split accounting by the worker that actually served.
+ENV_WORKER_SLOT = "WATERNET_WORKER_SLOT"
+ENV_WORKER_GENERATION = "WATERNET_WORKER_GENERATION"
+ENV_WORKER_ID = "WATERNET_WORKER_ID"
 
 # Health states (str, not enum: they go straight into JSON reports).
 STARTING = "starting"  # launched, no heartbeat yet (compile / data warmup)
@@ -140,8 +148,10 @@ class WorkerHealth:
     clock) and ``Popen.poll()``.
 
     A worker that exits is terminal (``done``/``dead``) regardless of
-    heartbeat age. Until the first *train-step* beat, only
-    ``startup_grace_sec`` (measured from launch) can declare a hang —
+    heartbeat age. Until the first *live-phase* beat (``live_phase``,
+    default ``"train"`` for trainer gangs, ``"serve"`` under the fleet
+    router), only ``startup_grace_sec`` (measured from launch) can
+    declare a hang —
     that window legitimately holds the jax import, the coordinator join,
     checkpoint restore, and the cold compile, announced only by
     startup-phase beats. From the first train beat on, record freshness
@@ -156,6 +166,7 @@ class WorkerHealth:
         hang_sec: float,
         startup_grace_sec: float,
         started_at: float,
+        live_phase: str = "train",
     ):
         if not late_sec <= hang_sec:
             raise ValueError(f"late_sec {late_sec} must be <= hang_sec {hang_sec}")
@@ -163,6 +174,12 @@ class WorkerHealth:
         self.hang_sec = float(hang_sec)
         self.startup_grace_sec = float(startup_grace_sec)
         self.started_at = float(started_at)
+        # Which beat phase proves the worker reached steady state: "train"
+        # for trainer gangs (the original machine), "serve" for the fleet
+        # router's serving workers. Until the first live-phase beat, only
+        # the startup grace can declare a hang — same reasoning, different
+        # warmup (AOT compile + bucket warm instead of restore + step one).
+        self.live_phase = str(live_phase)
         self.state = STARTING
         self.last_beat: Optional[float] = None
         self.first_step: Optional[int] = None
@@ -176,9 +193,10 @@ class WorkerHealth:
             self.last_beat = t
             step = int(record.get("step", 0))
             # first_step anchors "where this generation resumed": the first
-            # *train* beat carries the first post-resume step, while the
-            # startup beat is step 0 by construction and would pollute it.
-            if self.first_step is None and record.get("phase") == "train":
+            # *live-phase* beat carries the first post-warmup step, while
+            # the startup beat is step 0 by construction and would pollute
+            # it.
+            if self.first_step is None and record.get("phase") == self.live_phase:
                 self.first_step = step
             if self.last_step is None or step > self.last_step:
                 self.last_step = step

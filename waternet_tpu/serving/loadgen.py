@@ -105,6 +105,7 @@ def run_load(
     allow_downgrade: bool = False,
     window_sec: float = DEFAULT_WINDOW_SEC,
     collect_ledger: bool = False,
+    per_worker: bool = False,
 ) -> Dict:
     """Drive ``total`` POSTs at ``path`` with ``concurrency`` closed-loop
     workers cycling through ``payloads``; returns the accounting report.
@@ -127,9 +128,16 @@ def run_load(
     only the trailing ``window_sec`` of completions (see
     :func:`_window_block`) — the figure to read on a run long enough to
     degrade. ``collect_ledger=True`` additionally returns ``ledger``:
-    one ``{"t", "latency_ms", "outcome"}`` entry per request (``t``
-    seconds from run start), the input format of ``waternet-trace slo``
-    offline replay (docs/OBSERVABILITY.md).
+    one ``{"t", "latency_ms", "outcome", "worker"}`` entry per request
+    (``t`` seconds from run start), the input format of
+    ``waternet-trace slo`` offline replay (docs/OBSERVABILITY.md).
+
+    ``per_worker=True`` adds a ``per_worker`` block splitting the same
+    total accounting by the ``X-Worker-Id`` the answering serving
+    worker stamped (docs/SERVING.md "Fleet") — the client half of the
+    fleet bench's ledger-vs-router reconciliation. Answers without the
+    header (single-worker servers, router-originated errors) and
+    transport failures (nobody answered) land under ``"unattributed"``.
     """
     u = urlparse(url)
     host, port = u.hostname, u.port or 80
@@ -144,6 +152,7 @@ def run_load(
     ledger_entries: List[Dict] = []
     bodies: List = []
     failures: List[Dict] = []
+    per_worker_counts: Dict[str, Dict[str, int]] = {}
     truncated = [0]
     indices = itertools.count()
 
@@ -155,7 +164,8 @@ def run_load(
             truncated[0] += 1
 
     def record_ledger(rel_t: float, outcome: str,
-                      latency_s: Optional[float]) -> None:
+                      latency_s: Optional[float],
+                      worker: Optional[str] = None) -> None:
         # Caller holds `lock`.
         if collect_ledger:
             ledger_entries.append({
@@ -164,7 +174,20 @@ def run_load(
                     None if latency_s is None else round(latency_s * 1e3, 3)
                 ),
                 "outcome": outcome,
+                "worker": worker,
             })
+
+    def record_worker(worker: Optional[str], outcome: str) -> None:
+        # Caller holds `lock`. Split the same total accounting by the
+        # serving worker that stamped X-Worker-Id on the answer.
+        if not per_worker:
+            return
+        bucket = per_worker_counts.setdefault(
+            worker or "unattributed",
+            {"ok": 0, "shed": 0, "deadline_expired": 0, "rejected": 0,
+             "conn_reset": 0, "errors": 0, "downgraded": 0},
+        )
+        bucket[outcome] += 1
 
     def worker():
         import http.client
@@ -195,6 +218,7 @@ def run_load(
                     body = resp.read()
                     status = resp.status
                     served = resp.getheader("X-Tier-Served", "")
+                    wid = resp.getheader("X-Worker-Id", "") or None
                     closed = (
                         resp.getheader("Connection", "").lower() == "close"
                     )
@@ -213,6 +237,7 @@ def run_load(
                     )
                     with lock:
                         counts[key] += 1
+                        record_worker(None, key)
                         record_failure({
                             "request_id": rid,
                             "outcome": key,
@@ -231,14 +256,16 @@ def run_load(
                 with lock:
                     if status == 200:
                         counts["ok"] += 1
+                        record_worker(wid, "ok")
                         latencies.append(dt)
                         samples.append((t1 - t_run0, dt))
-                        record_ledger(t1 - t_run0, "ok", dt)
+                        record_ledger(t1 - t_run0, "ok", dt, worker=wid)
                         # Only meaningful when a tier was REQUESTED: a
                         # fast-default server answering tier-less traffic
                         # with X-Tier-Served: fast is not a downgrade.
                         if tier is not None and served and served != tier:
                             counts["downgraded"] += 1
+                            record_worker(wid, "downgraded")
                     else:
                         if status == 429:
                             outcome = "shed"
@@ -247,12 +274,13 @@ def run_load(
                         else:
                             outcome = "rejected"
                         counts[outcome] += 1
+                        record_worker(wid, outcome)
                         record_failure({
                             "request_id": rid,
                             "outcome": outcome,
                             "status": status,
                         })
-                        record_ledger(t1 - t_run0, outcome, None)
+                        record_ledger(t1 - t_run0, outcome, None, worker=wid)
                     if keep_bodies:
                         bodies.append((i, status, body))
                 if closed:
@@ -297,6 +325,8 @@ def run_load(
         report["bodies"] = bodies
     if collect_ledger:
         report["ledger"] = sorted(ledger_entries, key=lambda e: e["t"])
+    if per_worker:
+        report["per_worker"] = per_worker_counts
     return report
 
 
@@ -337,6 +367,7 @@ def run_stream_load(
     allow_downgrade: bool = False,
     timeout: float = 120.0,
     window_sec: float = DEFAULT_WINDOW_SEC,
+    per_worker: bool = False,
 ) -> Dict:
     """Replay ``payloads`` as ``streams`` paced concurrent POST /stream
     sessions (``frames`` frames each at ``fps``); returns the aggregate
@@ -355,7 +386,10 @@ def run_stream_load(
     graceful close is not a crash). ``refused`` counts sessions the
     server turned away at admission (503, degradation rung 3);
     ``downgraded`` counts delivered frames served by the fast tier
-    under brown-out (the record's downgrade flag).
+    under brown-out (the record's downgrade flag). ``per_worker=True``
+    adds ``per_worker_sessions`` — accepted sessions counted by the
+    ``X-Worker-Id`` on the response head, pinning which fleet worker
+    each session landed on (docs/SERVING.md "Fleet").
     """
     import socket
 
@@ -371,6 +405,7 @@ def run_stream_load(
     latencies: List[float] = []
     samples: List = []  # (t_recv - t_run0, latency_sec) delivered frames
     failures: List[Dict] = []
+    session_workers: Dict[str, int] = {}  # X-Worker-Id -> sessions
 
     def record_failure(rec: Dict) -> None:
         # Caller holds `lock`.
@@ -405,10 +440,18 @@ def run_stream_load(
             f = sock.makefile("rb")
             status_line = f.readline()
             status = int(status_line.split()[1]) if status_line else 0
-            while True:  # skip response headers
+            wid = None
+            while True:  # response headers: keep the worker stamp only
                 line = f.readline()
                 if not line or line in (b"\r\n", b"\n"):
                     break
+                name, _, value = line.decode("latin-1").partition(":")
+                if name.strip().lower() == "x-worker-id":
+                    wid = value.strip() or None
+            if status == 200 and per_worker:
+                with lock:
+                    key = wid or "unattributed"
+                    session_workers[key] = session_workers.get(key, 0) + 1
             if status != 200:
                 with lock:
                     outcome = "refused" if status == 503 else "errors"
@@ -537,7 +580,11 @@ def run_stream_load(
 
     lat_sorted = sorted(latencies)
     ok = counts["ok"]
+    per_worker_block = (
+        {"per_worker_sessions": session_workers} if per_worker else {}
+    )
     return {
+        **per_worker_block,
         "streams": int(streams),
         "frames_per_stream": int(frames),
         "offered_fps": float(fps),
@@ -615,6 +662,14 @@ def main(argv=None) -> int:
         "— the report's 'downgraded' counts how often it did.",
     )
     parser.add_argument(
+        "--per-worker", action="store_true", default=False,
+        help="Split the accounting by the X-Worker-Id each answer was "
+        "stamped with (docs/SERVING.md 'Fleet'): request mode adds a "
+        "'per_worker' counts block (and worker ids to --ledger "
+        "entries), stream mode adds 'per_worker_sessions'. Answers "
+        "without the header land under 'unattributed'.",
+    )
+    parser.add_argument(
         "--stream", action="store_true", default=False,
         help="Stream mode: replay the payloads as N paced concurrent "
         "POST /stream sessions (open-loop, like live cameras) with "
@@ -671,6 +726,7 @@ def main(argv=None) -> int:
             tier=args.tier,
             allow_downgrade=args.allow_downgrade,
             window_sec=args.window_sec,
+            per_worker=args.per_worker,
         )
         print(json.dumps(report))
         return 0
@@ -684,6 +740,7 @@ def main(argv=None) -> int:
         allow_downgrade=args.allow_downgrade,
         window_sec=args.window_sec,
         collect_ledger=args.ledger is not None,
+        per_worker=args.per_worker,
     )
     if args.ledger is not None:
         from pathlib import Path

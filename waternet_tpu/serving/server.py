@@ -81,6 +81,8 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
+import signal
 import sys
 import threading
 import time
@@ -92,6 +94,12 @@ from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.obs import trace
 from waternet_tpu.obs.prometheus import render_prometheus
 from waternet_tpu.resilience import faults
+from waternet_tpu.resilience.heartbeat import (
+    ENV_WORKER_GENERATION,
+    ENV_WORKER_ID,
+    ENV_WORKER_SLOT,
+    HeartbeatWriter,
+)
 from waternet_tpu.resilience.preemption import PreemptionGuard
 from waternet_tpu.serving.batcher import (
     DeadlineExpired,
@@ -258,6 +266,15 @@ class ServingServer:
             # CLI before any engine warms), and the armed engine grades
             # /healthz and annotates /stats + /metrics from then on.
             self.stats.arm_slo(SloEngine(parse_slo(slo), spec=slo))
+        # Fleet identity (docs/SERVING.md "Fleet"): when the fleet router
+        # spawned this process it named it via env; the name is stamped
+        # on every /enhance answer and stream head as X-Worker-Id so
+        # client-side ledgers can split accounting by the worker that
+        # actually served (waternet-loadgen --per-worker).
+        self.worker_id = os.environ.get(ENV_WORKER_ID) or None
+        self._ident: Tuple = (
+            (("X-Worker-Id", self.worker_id),) if self.worker_id else ()
+        )
         self.batcher: Optional[DynamicBatcher] = None
         self.streams: Optional[StreamManager] = None
         self.bound_port: Optional[int] = None
@@ -332,6 +349,7 @@ class ServingServer:
         if guard is not None:
             guard.__enter__()
         server = None
+        beat_task = None
         try:
             server = await asyncio.start_server(
                 self._handle_connection, self.host, self.port
@@ -343,6 +361,36 @@ class ServingServer:
                 f"{self.bound_port}",
                 flush=True,
             )
+
+            # Fleet heartbeats (resilience/heartbeat.py env contract):
+            # startup-phase beats announce the warmup, serve-phase beats
+            # prove steady state. The beat task rides THIS event loop on
+            # purpose — a wedged loop (gateway_hang) stops beats exactly
+            # when /healthz stops answering, so the router's two health
+            # signals agree by construction.
+            hb = HeartbeatWriter.resolve(
+                process_id=int(os.environ.get(ENV_WORKER_SLOT, "0") or 0),
+                generation=int(
+                    os.environ.get(ENV_WORKER_GENERATION, "0") or 0
+                ),
+            )
+            if hb is not None:
+                hb.beat(phase="startup", force=True)
+
+                async def _beat_loop():
+                    while True:
+                        hb.beat(
+                            step=self.stats.requests,
+                            phase=(
+                                "serve" if self.ready.is_set()
+                                else "startup"
+                            ),
+                        )
+                        await asyncio.sleep(hb.min_interval_sec / 2)
+
+                beat_task = asyncio.get_running_loop().create_task(
+                    _beat_loop()
+                )
 
             # AOT warmup in the executor: /healthz answers (503,
             # ready:false) the whole time, so orchestrators see a
@@ -416,6 +464,8 @@ class ServingServer:
             await asyncio.sleep(0.05)
             return 0 if clean else 1
         finally:
+            if beat_task is not None:
+                beat_task.cancel()
             if server is not None:
                 server.close()
                 await server.wait_closed()
@@ -565,6 +615,14 @@ class ServingServer:
                     writer, 405, {"error": "POST {\"weights\": path}"}
                 )
             return await self._reload(body, writer) and not want_close
+        if path == "/admin/policy":
+            if method != "POST":
+                return self._json(
+                    writer,
+                    405,
+                    {"error": 'POST {"downgrade_watermark": N|null}'},
+                )
+            return self._policy(body, writer) and not want_close
         return self._json(writer, 404, {"error": f"no route {path}"})
 
     def _healthz(self, writer) -> bool:
@@ -577,6 +635,7 @@ class ServingServer:
         ready = self.ready.is_set() and not self.draining.is_set()
         payload = {
             "ready": ready,
+            "worker_id": self.worker_id,
             "warmed": self.ready.is_set(),
             "draining": self.draining.is_set(),
             # Streams open right now: an honest readiness signal keeps
@@ -636,13 +695,28 @@ class ServingServer:
         # stamp it on every span this request touches — a failed loadgen
         # request can be found in the server trace by its id.
         req_id = _request_id(headers)
-        rid = (("X-Request-Id", req_id),)
+        rid = (("X-Request-Id", req_id),) + self._ident
 
         def jresp(status, payload, extra=(), close=False):
             return self._json(
                 writer, status, payload, extra=tuple(extra) + rid,
                 close=close,
             )
+
+        # Deterministic gateway faults (docs/RESILIENCE.md): the K-th
+        # /enhance ARRIVAL — counted before admission, so fault ordinals
+        # are arrival ordinals — can kill this whole process or wedge it.
+        gate = faults.gateway_fault()
+        if gate.crash:
+            # SIGKILL semantics on purpose: no goodbye bytes, the
+            # connection just drops mid-request — the failover the fleet
+            # router must absorb.
+            os.kill(os.getpid(), signal.SIGKILL)
+        if gate.hang is not None:
+            # Blocking the LOOP thread is the point: /healthz, the beat
+            # task, and every open connection freeze together, which is
+            # exactly the wedge the router's hang detection must catch.
+            gate.hang.wait()
 
         t_req0 = time.perf_counter() if trace.enabled() else None
         if self.draining.is_set():
@@ -842,7 +916,7 @@ class ServingServer:
         # every refusal and on the stream head; frame spans derive
         # per-frame ids as "<id>/<seq>" (docs/OBSERVABILITY.md).
         req_id = _request_id(headers)
-        rid = (("X-Request-Id", req_id),)
+        rid = (("X-Request-Id", req_id),) + self._ident
 
         def jresp(status, payload, extra=()):
             self._json(
@@ -905,9 +979,10 @@ class ServingServer:
                 "HTTP/1.1 200 OK\r\n"
                 "Content-Type: application/x-waternet-stream\r\n"
                 f"X-Request-Id: {req_id}\r\n"
-                "Connection: close\r\n"
-                "\r\n"
             )
+            if self.worker_id:
+                head += f"X-Worker-Id: {self.worker_id}\r\n"
+            head += "Connection: close\r\n\r\n"
             writer.write(head.encode("latin-1"))
             await writer.drain()
             await self.streams.handle(cfg, reader, writer, request_id=req_id)
@@ -984,6 +1059,57 @@ class ServingServer:
             )
         print(f"waternet-serve: reloaded weights from {path}", flush=True)
         return self._json(writer, 200, {"reloaded": True, "weights": path})
+
+    # -- /admin/policy -------------------------------------------------
+
+    def _policy(self, body, writer) -> bool:
+        """Runtime brown-out control (docs/SERVING.md "Fleet"): the fleet
+        router POSTs a lowered ``downgrade_watermark`` on sustained SLO
+        ``page`` burn so opted-in quality traffic downgrades earlier
+        fleet-wide, and restores it on sustained ``ok``. The watermark is
+        a plain attribute the batcher reads at dispatch time, so the
+        shift applies to the next coalesced batch — no restart, no
+        reconfigure."""
+        if not self.ready.is_set():
+            return self._json(writer, 503, {"error": "not ready"})
+        try:
+            payload = json.loads(body or b"{}")
+            if not isinstance(payload, dict):
+                raise ValueError
+        except ValueError:
+            return self._json(
+                writer,
+                400,
+                {"error": 'body must be JSON {"downgrade_watermark": '
+                 'N|null}'},
+            )
+        if "downgrade_watermark" in payload:
+            value = payload["downgrade_watermark"]
+            bad = value is not None and (
+                isinstance(value, bool)
+                or not isinstance(value, int)
+                or value < 1
+            )
+            if bad:
+                return self._json(
+                    writer,
+                    400,
+                    {
+                        "error": "downgrade_watermark must be a positive "
+                        f"int or null, got {value!r}"
+                    },
+                )
+            self.batcher.downgrade_watermark = value
+        return self._json(
+            writer,
+            200,
+            {
+                "policy": {
+                    "downgrade_watermark": self.batcher.downgrade_watermark,
+                    "admit_watermark": self.admit_watermark,
+                }
+            },
+        )
 
 
 # ----------------------------------------------------------------------
