@@ -1338,6 +1338,147 @@ def bench_stream(
     }
 
 
+def bench_stream_reuse(
+    max_batch=None, max_buckets=None, base_hw=None,
+    streams=None, frames=None, static_pct=None,
+):
+    """Temporal-reuse A/B (serving/reuse.py, docs/SERVING.md "Temporal
+    reuse & response cache"): the same ≥70%-static synthetic streams
+    served twice by one server — reuse OFF (always-compute control) vs
+    reuse ON — reporting the contract line ``stream_reuse_fps``.
+
+    Both arms offer the identical deterministic redundancy mix
+    (loadgen ``_stream_payloads``: ``static_pct`` of frames repeat
+    their predecessor byte-for-byte), unpaced with a generous budget so
+    nothing drops and the effective rate measures pure service
+    capacity. The contract value is the reuse arm's effective
+    fps/stream (computed + reused answers); ``effective_fps_multiplier``
+    is the reuse-on / reuse-off ratio (the ISSUE bar: ≥ 2x at a
+    70%-static mix on CPU smoke). Both arms' delivered frames are
+    decoded and scored with :func:`waternet_tpu.metrics.flicker.
+    flicker_index` — reuse replays the *identical* enhanced bytes for
+    an identical input frame, so ``flicker_index_delta`` must stay
+    within noise of the always-compute control. ``accounted``
+    cross-checks the client ledgers (incl. ``reused``) against the
+    server's ``/stats`` stream counters.
+    """
+    import cv2
+    import numpy as np
+
+    from waternet_tpu.inference_engine import InferenceEngine
+    from waternet_tpu.metrics.flicker import flicker_index
+    from waternet_tpu.serving import derive_buckets
+    from waternet_tpu.serving.loadgen import _stream_payloads, run_stream_load
+    from waternet_tpu.serving.server import ServingServer
+
+    _, max_batch, max_buckets = _serving_env_defaults(
+        None, max_batch, max_buckets
+    )
+    base = HW if base_hw is None else base_hw
+    n_streams = (
+        _env_int("WATERNET_BENCH_STREAMS", 4) if streams is None else streams
+    )
+    n_frames = (
+        _env_int("WATERNET_BENCH_STREAM_FRAMES", 12)
+        if frames is None else frames
+    )
+    pct = (
+        _env_int("WATERNET_BENCH_STATIC_PCT", 75)
+        if static_pct is None else static_pct
+    )
+
+    params = _serving_params()
+    shape = (base, base * 4 // 3)
+    payloads = _stream_payloads(
+        f"{shape[0]}x{shape[1]}", n=n_frames, static_pct=pct
+    )
+    ladder = derive_buckets([shape], max_buckets=max_buckets)
+
+    server = ServingServer(
+        InferenceEngine(params=params), ladder,
+        max_batch=max_batch, max_wait_ms=5.0, replicas=1,
+        max_queue=8 * max_batch, admit_watermark=8 * max_batch,
+        max_streams=2 * n_streams,
+        stream_window=8,
+    )
+    t0 = time.perf_counter()
+    server.start_background()
+    server.wait_ready()
+    warmup_s = time.perf_counter() - t0
+    try:
+        control = run_stream_load(
+            server.url, payloads, streams=n_streams, frames=n_frames,
+            fps=500.0, budget_ms=60_000.0, window=16, keep_frames=True,
+        )
+        reuse = run_stream_load(
+            server.url, payloads, streams=n_streams, frames=n_frames,
+            fps=500.0, budget_ms=60_000.0, window=16, keep_frames=True,
+            reuse_threshold=1.0, max_reuse_run=n_frames,
+        )
+    finally:
+        server.request_drain()
+        server.join()
+    summary = server.stats.summary()
+    st = summary["streams"]
+
+    def _mean_flicker(report):
+        # Per stream: the ordered delivered frames exactly as a viewer
+        # would decode them (computed F and reused R records alike).
+        vals = []
+        for recs in report.get("frames", {}).values():
+            rgb = [
+                cv2.imdecode(
+                    np.frombuffer(png, np.uint8), cv2.IMREAD_COLOR
+                )[:, :, ::-1].astype(np.float32)
+                for _, _, png in sorted(recs)
+            ]
+            if len(rgb) >= 2:
+                vals.append(flicker_index(rgb))
+        return float(np.mean(vals)) if vals else 0.0
+
+    flicker_control = _mean_flicker(control)
+    flicker_reuse = _mean_flicker(reuse)
+    phases = (control, reuse)
+    accounted = (
+        st["frames_delivered"] == sum(p["ok"] for p in phases)
+        and st["frames_reused"] == sum(p["reused"] for p in phases)
+        and st["frames_dropped"] == sum(p["dropped"] for p in phases)
+        and st["frames_out_of_budget"]
+        == sum(p["out_of_budget"] for p in phases)
+        and all(p["errors"] == 0 for p in phases)
+        and all(p["conn_reset"] == 0 for p in phases)
+        and all(p["frame_errors"] == 0 for p in phases)
+    )
+    control_fps = max(0.01, control["fps_per_stream"])
+    return {
+        "metric": "stream_reuse_fps",
+        "value": reuse["fps_per_stream"],
+        "unit": "fps/stream",
+        "vs_baseline": round(reuse["fps_per_stream"] / control_fps, 3),
+        "effective_fps_multiplier": round(
+            reuse["fps_per_stream"] / control_fps, 3
+        ),
+        "control_fps_per_stream": control["fps_per_stream"],
+        "reuse_rate": round(
+            reuse["reused"] / max(1, reuse["frames_sent"]), 4
+        ),
+        "frames_reused": reuse["reused"],
+        "static_pct": pct,
+        "streams": n_streams,
+        "frames_per_stream": n_frames,
+        "flicker_index_control": round(flicker_control, 4),
+        "flicker_index_reuse": round(flicker_reuse, 4),
+        "flicker_index_delta": round(flicker_reuse - flicker_control, 4),
+        "accounted": bool(accounted),
+        "frames_delivered": st["frames_delivered"],
+        "frames_dropped": st["frames_dropped"],
+        "compiles": summary["compiles"],
+        "buckets": ladder.describe(),
+        "warmup_sec": round(warmup_s, 1),
+        "max_batch": max_batch,
+    }
+
+
 def bench_tiers(
     n_images=None, max_batch=None, max_buckets=None, base_hw=None,
 ):
@@ -2088,7 +2229,7 @@ def main():
         "--config",
         choices=["train", "video", "serve", "serve_multi", "serve_http",
                  "serve_chaos", "serve_fleet", "train_chaos", "tiers",
-                 "stream", "obs"],
+                 "stream", "stream_reuse", "obs"],
         default="train",
         help="train (default; the one-line contract metric), video "
         "(full-res frame throughput, BASELINE config 5), serve "
@@ -2115,6 +2256,10 @@ def main():
         "stream (N paced concurrent POST /stream sessions: sustained "
         "fps/stream, p99 frame latency vs budget, drop/downgrade rate "
         "at 2x real-time load — docs/SERVING.md 'Streaming'), "
+        "stream_reuse (temporal-reuse A/B on a mostly-static stream "
+        "mix: reuse-off control vs reuse-on effective fps, reuse rate, "
+        "flicker-index delta — docs/SERVING.md 'Temporal reuse & "
+        "response cache'), "
         "or obs (tracing overhead A/B: serving throughput with the "
         "span recorder disarmed vs armed, byte-identity asserted — "
         "docs/OBSERVABILITY.md 'Overhead')",
@@ -2138,6 +2283,7 @@ def main():
         "train_chaos": "chaos_train_images_per_sec",
         "tiers": "fast_tier_images_per_sec",
         "stream": "video_stream_fps",
+        "stream_reuse": "stream_reuse_fps",
         "obs": "obs_overhead_pct",
     }.get(args.config, "uieb_train_images_per_sec_per_chip")
 
@@ -2245,6 +2391,10 @@ def main():
 
     if args.config == "stream":
         print(json.dumps(bench_stream()))
+        return
+
+    if args.config == "stream_reuse":
+        print(json.dumps(bench_stream_reuse()))
         return
 
     if args.config == "obs":

@@ -349,6 +349,7 @@ def test_headline_devpre_rank(bench):
     assert names[0] == "train_bf16_r6_devpre"
 
 
+@pytest.mark.slow  # ~71 s full CLI run: fail-line/headline unit tests above stay tier-1
 def test_bench_output_contract_cpu():
     """End-to-end: `python bench.py` prints the `_hostfed_sync` pipeline
     A/B variant first, the host-fed apples-to-apples line second (carrying

@@ -177,6 +177,8 @@ def test_device_preprocess_midepoch_resume_bit_identical():
     )
 
 
+@pytest.mark.slow  # ~20 s: the serving suites arm the same compile sentinel fast;
+# fused-entry + midepoch-resume stay tier-1 here
 def test_device_preprocess_zero_midepoch_recompiles(compile_sentinel):
     """The raw-uint8 step programs are compiled once: a warm epoch, then a
     full pipelined train epoch + eval epoch with every armed jit cache

@@ -131,6 +131,7 @@ def test_live_distill_smoke_loss_falls(fixture_pair, data):
     assert np.isfinite(last["loss"])
 
 
+@pytest.mark.slow  # ~19 s VGG trace: the non-perceptual distill smoke stays tier-1
 def test_distill_with_perceptual_term_traces(fixture_pair, data):
     """The Perceptual-Losses distillation recipe (VGG term on
     student-vs-teacher-output) compiles and yields finite losses."""
@@ -163,6 +164,8 @@ def test_distill_guards(fixture_pair):
         eng.cache_dataset(SyntheticPairs(2, HW, HW, seed=0), np.arange(2))
 
 
+@pytest.mark.slow  # full CLI run: the live-distill smoke + hub triple-load +
+# flag-conflict tests keep the distill surface fast
 def test_distill_cli_produces_servable_student(tmp_path, monkeypatch, data):
     """train.py --distill end to end at smoke scale: the run's last.npz
     is a student checkpoint the fast tier loads and serves (the

@@ -119,6 +119,7 @@ def test_clahe_matmul_hist_chunked_bitexact(rng, monkeypatch):
     np.testing.assert_array_equal(got, want.astype(np.float32))
 
 
+@pytest.mark.slow  # grid sweep: interp_chunked_bitexact keeps the interp path fast
 def test_clahe_matmul_interp_grid_fuzz(rng, monkeypatch):
     """The cell decomposition must stay cv2-bit-exact for non-default tile
     grids too (non-square, coarse, fine) — the generalized machinery's
@@ -223,6 +224,7 @@ def test_clahe_core_bitexact_nondivisible(rng):
     np.testing.assert_array_equal(got, want.astype(np.float32))
 
 
+@pytest.mark.slow  # ~24 s shape sweep: vs_cv2 + nondivisible keep the core pin fast
 def test_clahe_core_bitexact_fuzz_shapes(rng):
     """The bit-exactness claim must hold across arbitrary shapes (odd tile
     sizes exercise the float32-reciprocal coordinate ties; narrow images
@@ -244,6 +246,7 @@ def test_clahe_core_bitexact_fuzz_shapes(rng):
         )
 
 
+@pytest.mark.slow  # ~93 s: interp_grid_fuzz + interp_chunked keep the MXU interp path fast
 def test_clahe_matmul_interp_bitexact(rng, monkeypatch):
     """The MXU one-hot-matmul interpolation path (half-tile cells, bf16
     one-hot batched matmul) must stay bit-exact vs cv2 wherever it engages
@@ -486,6 +489,7 @@ def test_degenerate_frames_no_nan(frame):
         assert np.isfinite(a).all(), "NaN/inf leaked from device transform"
 
 
+@pytest.mark.slow  # cap sweep re-proves the chunked bitexact pins across env caps
 def test_clahe_matmul_cap_env_sweep_bitexact(rng, monkeypatch):
     """WATERNET_CLAHE_MATMUL_CAP_MB re-sizes the one-hot chunking /cell
     grouping at trace time; any cap must produce bit-identical CLAHE (only
@@ -519,6 +523,7 @@ def test_clahe_matmul_cap_env_sweep_bitexact(rng, monkeypatch):
         clahe_mod._matmul_cap_bytes()
 
 
+@pytest.mark.slow  # dtype A/B sweep: the default int8 path stays pinned fast above
 def test_clahe_onehot_dtype_modes_bitexact(rng, monkeypatch):
     """The histogram one-hot operand dtype (WATERNET_CLAHE_ONEHOT: int8
     default, bf16/f32 for A/B) must not change a single count — products

@@ -145,6 +145,7 @@ def test_restore_mismatch_names_param_path(tmp_path):
     assert "(3, 3, 12, 99)" in msg and "(7, 7, 12, 128)" in msg
 
 
+@pytest.mark.slow  # ~35 s: devpre midepoch resume + resume_auto fallback stay tier-1
 def test_host_preprocess_midepoch_resume_matches_uninterrupted():
     """Host-augment fast-forward must mirror PADDED batch consumption.
 
@@ -194,6 +195,8 @@ def test_manager_retention_keeps_last_n_plus_best(tmp_path):
     assert kept == [2, 4, 5]
 
 
+@pytest.mark.slow  # ~23 s: resume_auto truncated-fallback + scan-junk tests pin the
+# same skip/fallback contract fast
 def test_manager_skips_unfinalized_and_falls_back_past_corrupt(tmp_path):
     import jax
 
@@ -286,6 +289,8 @@ def test_auto_resume_legacy_state_dir(tmp_path):
 # ----------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~49 s: cli_nan_guard + divergence-budget tests keep the
+# NaN-containment contract fast
 def test_nan_fault_rollback_and_skip(tmp_path):
     """An injected NaN step is contained: rollback + skip, finite result,
     counters reported — and the final state matches a run that never saw
@@ -469,6 +474,8 @@ def _assert_run_artifacts_identical(a: Path, b: Path):
     assert all(np.array_equal(wa[k], wb[k]) for k in wa.files)
 
 
+@pytest.mark.slow  # ~50 s/variant: checkpoint_every_steps + train_cli resume +
+# devpre midepoch resume keep the drain/resume contract fast
 @pytest.mark.parametrize("extra", [[], ["--device-cache"]],
                          ids=["host-fed", "device-cache"])
 def test_sigterm_midepoch_resume_is_bit_identical(tmp_path, monkeypatch, extra):

@@ -1,0 +1,783 @@
+"""Compute reuse (waternet_tpu/serving/reuse.py, docs/SERVING.md
+"Temporal reuse & response cache"): the ISSUE 17 acceptance pins —
+gating byte-identity (a delta-of-zero frame reused from the cache is
+byte-identical to a recompute; reuse off is byte-identical to the
+always-compute server), the staleness cap forcing recomputes, scene
+cuts never reused, coarse block-flow pan detection, the bounded LRU
+response cache (hit byte-identity, X-Cache stamps, /admin/reload
+invalidation, LRU eviction, generation-refused racing puts), brown-out
+policy correctness (a downgraded answer is never cached), the
+disconnect interplay (per-frame accounting identity incl. ``reused``),
+zero jit-cache growth across reuse traffic, the /stats + /metrics
+surfaces, the fleet router wiring, and the bench stream_reuse contract
+line (effective-fps multiplier and flicker bound).
+"""
+
+import http.client
+import json
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from waternet_tpu.resilience import faults
+from waternet_tpu.serving import BucketLadder, SupervisionConfig
+from waternet_tpu.serving.loadgen import run_load, run_stream_load
+from waternet_tpu.serving.reuse import (
+    DEFAULT_MAX_REUSE_RUN,
+    FrameDeltaGate,
+    ResponseCache,
+    block_flow,
+    decimate,
+    delta_score,
+    empty_cache_block,
+    shift_frame,
+)
+from waternet_tpu.serving.server import ServingServer
+from waternet_tpu.serving.streams import (
+    FLAG_REUSED,
+    FRAME_LEN,
+    KIND_END,
+    KIND_FRAME,
+    KIND_REUSED,
+    REC_HEAD,
+)
+from waternet_tpu.utils.tensor import ten2arr
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.distill_fixture import FIXTURE_DIR  # noqa: E402
+
+# Lock-order watchdog on the whole threaded suite (docs/LINT.md
+# "Concurrency rules", tests/conftest.py::locktrace).
+pytestmark = pytest.mark.usefixtures("locktrace")
+
+BUCKET = (32, 32)
+MAX_BATCH = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+    import jax.numpy as jnp
+
+    from waternet_tpu.models import WaterNet
+
+    x = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    return WaterNet().init(jax.random.PRNGKey(0), x, x, x, x)
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    from waternet_tpu.inference_engine import InferenceEngine
+
+    return InferenceEngine(params=params)
+
+
+@pytest.fixture(scope="module")
+def student_params():
+    from waternet_tpu.hub import resolve_weights
+
+    return resolve_weights(str(FIXTURE_DIR / "student.npz"))
+
+
+def _sup(**kw):
+    kw.setdefault("scan_interval_sec", 0.005)
+    kw.setdefault("rewarm_backoff_sec", 0.01)
+    return SupervisionConfig(**kw)
+
+
+def _wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _png(rgb):
+    import cv2
+
+    ok, buf = cv2.imencode(".png", rgb[:, :, ::-1])
+    assert ok
+    return buf.tobytes()
+
+
+def _request(port, method, path, body=None, headers=None, timeout=60.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, dict(resp.getheaders()), data
+    finally:
+        conn.close()
+
+
+def _get_json(port, path):
+    status, _, body = _request(port, "GET", path)
+    return status, json.loads(body)
+
+
+def _open_stream(port, headers=None, timeout=60.0):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    lines = [
+        "POST /stream HTTP/1.1",
+        f"Host: 127.0.0.1:{port}",
+    ] + [f"{k}: {v}" for k, v in (headers or {}).items()]
+    sock.sendall(("\r\n".join(lines) + "\r\n\r\n").encode("latin-1"))
+    f = sock.makefile("rb")
+    status = int(f.readline().split()[1])
+    while True:
+        line = f.readline()
+        if not line or line in (b"\r\n", b"\n"):
+            break
+    return sock, f, status
+
+
+def _send_frame(sock, payload):
+    sock.sendall(FRAME_LEN.pack(len(payload)) + payload)
+
+
+def _send_end(sock):
+    sock.sendall(FRAME_LEN.pack(0))
+
+
+def _read_records(f):
+    recs = []
+    while True:
+        head = f.read(REC_HEAD.size)
+        if len(head) < REC_HEAD.size:
+            break
+        kind, flags, seq, n = REC_HEAD.unpack(head)
+        payload = f.read(n) if n else b""
+        recs.append((kind, flags, seq, payload))
+        if kind == KIND_END:
+            break
+    return recs
+
+
+def _summary_record(recs):
+    assert recs and recs[-1][0] == KIND_END, recs
+    return json.loads(recs[-1][3])
+
+
+# ---------------------------------------------------------------------------
+# FrameDeltaGate unit pins (pure numpy, no server)
+# ---------------------------------------------------------------------------
+
+
+def test_gate_zero_delta_reuses_and_staleness_cap_forces_recompute(rng):
+    """An identical frame materializes the IDENTICAL enhanced array
+    (the byte-identity root: same array -> same deterministic PNG), the
+    consecutive-decision counter enforces max_reuse_run, and a new
+    anchor resets the run."""
+    raw = np.asarray(rng.integers(0, 256, (40, 52, 3)), dtype=np.uint8)
+    enhanced = np.asarray(rng.integers(0, 256, (40, 52, 3)), dtype=np.uint8)
+    gate = FrameDeltaGate(threshold=0.5, max_reuse_run=3)
+    assert gate.check(raw) is None  # no anchor yet -> compute
+    gate.note_submitted(raw, 0)
+    gate.note_computed(0, enhanced, flags=0)
+    for _ in range(3):
+        decision = gate.check(raw)
+        assert decision is not None
+        assert decision == (0.0, 0.0, 0)  # static scene, anchor seq 0
+        out, flags = gate.materialize(decision)
+        assert out is enhanced  # the identical array, not a copy
+        assert flags == 0
+    # 4th consecutive reuse: the staleness cap says recompute, even
+    # though the delta is still zero.
+    assert gate.check(raw) is None
+    gate.note_submitted(raw, 4)
+    gate.note_computed(4, enhanced, flags=0)
+    assert gate.check(raw) is not None  # run reset by the recompute
+
+
+def test_gate_lost_anchor_refuses_to_materialize(rng):
+    """A reuse decision whose anchor never delivered (the anchor was
+    dropped or errored before its turn) materializes to None — the
+    session turns it into an honest drop instead of replaying the
+    previous scene."""
+    a = np.asarray(rng.integers(0, 256, (40, 52, 3)), dtype=np.uint8)
+    b = np.asarray(rng.integers(0, 256, (40, 52, 3)), dtype=np.uint8)
+    gate = FrameDeltaGate(threshold=1.0)
+    gate.note_submitted(a, 0)
+    gate.note_computed(0, a)
+    # Scene cut at seq 5: submitted, becomes the anchor — but its
+    # compute never delivers (no note_computed for seq 5).
+    assert gate.check(b) is None
+    gate.note_submitted(b, 5)
+    decision = gate.check(b)
+    assert decision is not None and decision[2] == 5
+    assert gate.materialize(decision) is None
+    # Once seq 5 DOES deliver, the same decision materializes.
+    gate.note_computed(5, b)
+    out, _ = gate.materialize(decision)
+    assert out is b
+
+
+def test_gate_scene_cut_and_resolution_change_never_reuse(rng):
+    """A scene cut scores far past any sane threshold, and a resolution
+    change bypasses scoring entirely."""
+    a = np.asarray(rng.integers(0, 256, (40, 52, 3)), dtype=np.uint8)
+    b = np.asarray(rng.integers(0, 256, (40, 52, 3)), dtype=np.uint8)
+    gate = FrameDeltaGate(threshold=1.0)
+    gate.note_submitted(a, 0)
+    gate.note_computed(0, a)
+    assert gate.check(b) is None  # cut
+    other = np.asarray(rng.integers(0, 256, (30, 52, 3)), dtype=np.uint8)
+    assert gate.check(other) is None  # shape change
+    # The anchor survives both rejections: the original still reuses.
+    assert gate.check(a) is not None
+    with pytest.raises(ValueError):
+        FrameDeltaGate(threshold=-1.0)
+    with pytest.raises(ValueError):
+        FrameDeltaGate(threshold=1.0, max_reuse_run=0)
+
+
+def test_block_flow_finds_pan_and_warp_gate_reuses_it():
+    """A structured scene panned by 2 px: plain delta sees motion,
+    block_flow finds the offset (backward convention: content came from
+    x - k, so dx = -k) with near-zero residual, and a warp-enabled gate
+    reuses the frame where a plain gate recomputes."""
+    yy, xx = np.mgrid[0:48, 0:48].astype(np.float32)
+    scene = (127 + 90 * np.sin(xx / 5.0) * np.cos(yy / 7.0)).clip(0, 255)
+    prev = np.repeat(scene[..., None], 3, axis=-1).astype(np.uint8)
+    cur = np.roll(prev, 2, axis=1)  # pan right by 2 px (< FLOW_RADIUS)
+
+    ps, cs = decimate(prev), decimate(cur)  # 48 < DECIMATED_EDGE: stride 1
+    plain = delta_score(ps, cs)
+    flow_score, (dx, dy) = block_flow(ps, cs)
+    assert (dx, dy) == (-2, 0)
+    assert flow_score < 1e-6 < plain
+
+    # shift_frame under the same convention: valid interior pixels of
+    # the warped previous frame reproduce the current frame exactly.
+    shifted = shift_frame(prev, -2.0, 0.0)
+    np.testing.assert_array_equal(shifted[:, 4:], cur[:, 4:])
+
+    plain_gate = FrameDeltaGate(threshold=1.0)
+    plain_gate.note_submitted(prev, 0)
+    plain_gate.note_computed(0, prev)
+    assert plain_gate.check(cur) is None  # pan reads as motion
+    warp_gate = FrameDeltaGate(threshold=1.0, warp=True)
+    warp_gate.note_submitted(prev, 0)
+    warp_gate.note_computed(0, prev)
+    decision = warp_gate.check(cur)
+    assert decision is not None
+    assert decision == (-2.0, 0.0, 0)  # stride 1: pixel == cell offset
+    out, _ = warp_gate.materialize(decision)
+    np.testing.assert_array_equal(out[:, 4:], cur[:, 4:])
+
+
+def test_response_cache_lru_eviction_generation_and_counters():
+    cache = ResponseCache(2, ladder_id="32x32")
+    k1 = cache.key(b"payload-1", "quality")
+    k2 = cache.key(b"payload-2", "quality")
+    k3 = cache.key(b"payload-3", "quality")
+    assert cache.get(k1) is None  # miss
+    cache.put(k1, b"a")
+    cache.put(k2, b"b")
+    assert cache.get(k1) == b"a"  # k1 now most-recently-used
+    cache.put(k3, b"c")  # capacity 2: evicts k2 (LRU), not k1
+    assert cache.get(k2) is None
+    assert cache.get(k1) == b"a"
+    # Same payload, different tier: a different key entirely.
+    assert cache.get(cache.key(b"payload-1", "fast")) is None
+    gen = cache.invalidate()
+    assert gen == 1
+    assert cache.get(cache.key(b"payload-1", "quality")) is None
+    cache.put(k1, b"stale")  # old-generation key: refused
+    assert cache.get(cache.key(b"payload-1", "quality")) is None
+    c = cache.counters()
+    assert c["enabled"] is True and c["capacity"] == 2
+    assert c["hits"] == 2 and c["misses"] == 5 and c["evictions"] == 1
+    assert c["entries"] == 0 and c["generation"] == 1
+    assert set(empty_cache_block()) == set(c)
+    with pytest.raises(ValueError):
+        ResponseCache(0)
+
+
+# ---------------------------------------------------------------------------
+# Stream reuse over the wire: byte-identity, caps, accounting, zero jit
+# ---------------------------------------------------------------------------
+
+
+def test_stream_reuse_byte_identity_r_records_and_stats(
+    engine, rng, compile_sentinel
+):
+    """The tentpole pin: with reuse opted in, a repeated frame comes
+    back as an R record whose PNG bytes are IDENTICAL to the computed F
+    record for the same content (delta-of-zero reuse == recompute), a
+    scene cut recomputes, the Z summary and /stats count reused frames,
+    the frame_reuse trace span is emitted, and none of it grows any jit
+    cache."""
+    from waternet_tpu.obs import trace
+
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, replicas=1, max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    compile_sentinel.arm(forward=engine._forward)
+    trace.reset()
+    trace.enable()
+    try:
+        a = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        b = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        sock, f, status = _open_stream(
+            srv.bound_port,
+            {"X-Stream-Fps": "50", "X-Stream-Budget-Ms": "60000",
+             "X-Stream-Reuse": "1.0"},
+        )
+        assert status == 200
+        for rgb in (a, a, a, b, b):
+            _send_frame(sock, _png(rgb))
+        _send_end(sock)
+        recs = _read_records(f)
+        sock.close()
+
+        kinds = [r[0] for r in recs[:-1]]
+        assert kinds == [KIND_FRAME, KIND_REUSED, KIND_REUSED,
+                         KIND_FRAME, KIND_REUSED], recs
+        # Byte-identity: each reused record replays the exact bytes its
+        # computed anchor produced — a viewer cannot tell reuse from
+        # recompute on a static scene.
+        assert recs[1][3] == recs[0][3] and recs[2][3] == recs[0][3]
+        assert recs[4][3] == recs[3][3]
+        assert recs[3][3] != recs[0][3]  # the cut really recomputed
+        for rec in (recs[1], recs[2], recs[4]):
+            assert rec[1] & FLAG_REUSED
+        assert not recs[0][1] & FLAG_REUSED
+        z = _summary_record(recs)
+        assert z["delivered"] == 2 and z["reused"] == 3
+        assert z["dropped"] == 0 and z["errors"] == 0
+
+        _, stats = _get_json(srv.bound_port, "/stats")
+        assert stats["streams"]["frames_reused"] == 3
+        assert stats["streams"]["frames_delivered"] == 2
+        doc = trace.recorder().to_chrome()
+        spans = [e.get("name") for e in doc["traceEvents"]]
+        assert "frame_reuse" in spans
+        status, _, body = _request(srv.bound_port, "GET", "/metrics")
+        assert status == 200
+        assert b"waternet_stream_frames_reused_total 3" in body
+    finally:
+        trace.disable()
+        trace.reset()
+        srv.request_drain()
+        assert srv.join() == 0
+    compile_sentinel.check()  # reuse path compiles nothing
+
+
+def test_stream_reuse_off_is_byte_identical_to_today(engine, rng):
+    """No opt-in header, no server default: repeated frames are all
+    computed F records (no R kind on the wire), each byte-identical —
+    the PR-16 behavior, untouched."""
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, replicas=1, max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        a = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        sock, f, status = _open_stream(
+            srv.bound_port,
+            {"X-Stream-Fps": "50", "X-Stream-Budget-Ms": "60000"},
+        )
+        assert status == 200
+        for _ in range(3):
+            _send_frame(sock, _png(a))
+        _send_end(sock)
+        recs = _read_records(f)
+        sock.close()
+        assert [r[0] for r in recs[:-1]] == [KIND_FRAME] * 3
+        assert recs[1][3] == recs[0][3] == recs[2][3]
+        z = _summary_record(recs)
+        assert z["delivered"] == 3 and z["reused"] == 0
+        _, stats = _get_json(srv.bound_port, "/stats")
+        assert stats["streams"]["frames_reused"] == 0
+        assert stats["cache"] == empty_cache_block()
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+def test_stream_reuse_staleness_cap_header(engine, rng):
+    """X-Stream-Max-Reuse-Run: 2 on an unchanging scene: the record
+    pattern is F R R F R R — every third frame recomputes no matter
+    what the delta says."""
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, replicas=1, max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        a = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        sock, f, status = _open_stream(
+            srv.bound_port,
+            {"X-Stream-Fps": "50", "X-Stream-Budget-Ms": "60000",
+             "X-Stream-Reuse": "100.0", "X-Stream-Max-Reuse-Run": "2"},
+        )
+        assert status == 200
+        for _ in range(6):
+            _send_frame(sock, _png(a))
+        _send_end(sock)
+        recs = _read_records(f)
+        sock.close()
+        assert [r[0] for r in recs[:-1]] == [
+            KIND_FRAME, KIND_REUSED, KIND_REUSED,
+            KIND_FRAME, KIND_REUSED, KIND_REUSED,
+        ]
+        assert _summary_record(recs)["reused"] == 4
+        # Bad reuse headers are a 400 at session open, not a wedge.
+        sock2, f2, status2 = _open_stream(
+            srv.bound_port, {"X-Stream-Reuse": "-3"},
+        )
+        assert status2 == 400
+        sock2.close()
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+@pytest.mark.slow  # fault + full loadgen run: the byte-identity + staleness stream
+# tests keep the reuse wire contract fast
+def test_stream_reuse_disconnect_accounting_identity(engine, rng):
+    """stream_disconnect@1 under a reuse-enabled session: the loadgen
+    per-frame identity still holds with the new bucket — ok + reused +
+    dropped + out_of_budget + frame_errors + conn_reset == frames_sent —
+    and the server books the undelivered queued frames as drops."""
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, replicas=1, max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    payload = _png(
+        np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+    )
+    faults.install(faults.FaultPlan.parse("stream_disconnect@1"))
+    try:
+        report = run_stream_load(
+            srv.url, [payload], streams=1, frames=6, fps=100.0,
+            budget_ms=5000.0, reuse_threshold=1.0,
+        )
+    finally:
+        faults.clear()
+    try:
+        assert report["conn_reset"] >= 1, report
+        assert report["errors"] == 0 and report["frame_errors"] == 0
+        assert (
+            report["ok"] + report["reused"] + report["dropped"]
+            + report["out_of_budget"] + report["frame_errors"]
+            + report["conn_reset"] == report["frames_sent"]
+        ), report
+        _wait_for(
+            lambda: _get_json(srv.bound_port, "/healthz")[1][
+                "active_streams"
+            ] == 0,
+            what="session cleanup",
+        )
+        # A fresh reuse session on the same server delivers everything:
+        # one computed frame, the rest reused.
+        report2 = run_stream_load(
+            srv.url, [payload], streams=1, frames=4, fps=50.0,
+            budget_ms=10000.0, reuse_threshold=1.0,
+        )
+        assert report2["ok"] + report2["reused"] == 4, report2
+        assert report2["reused"] >= 2
+        assert report2["fps_per_stream"] > 0
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+# ---------------------------------------------------------------------------
+# /enhance response cache: hits, reload invalidation, policy, fleet wiring
+# ---------------------------------------------------------------------------
+
+
+def test_enhance_cache_hit_byte_identity_and_reload_invalidation(
+    engine, params, rng, tmp_path,
+):
+    """Identical payload bytes hit the cache (X-Cache: miss then hit,
+    bodies byte-identical); /admin/reload invalidates — the next
+    request is a miss under the new generation, still byte-identical
+    because the reloaded weights are the same."""
+    from waternet_tpu.utils.checkpoint import save_weights
+
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, replicas=1, max_queue=64, response_cache=8,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        port = srv.bound_port
+        payload = _png(
+            np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        )
+        s1, h1, b1 = _request(port, "POST", "/enhance", body=payload)
+        assert s1 == 200 and h1.get("X-Cache") == "miss"
+        s2, h2, b2 = _request(port, "POST", "/enhance", body=payload)
+        assert s2 == 200 and h2.get("X-Cache") == "hit"
+        assert b2 == b1, "cache hit must replay the exact bytes"
+        assert h2.get("X-Tier-Served") == "quality"
+
+        _, stats = _get_json(port, "/stats")
+        assert stats["cache"]["enabled"] is True
+        assert stats["cache"]["hits"] == 1
+        assert stats["cache"]["misses"] == 1
+        assert stats["cache"]["entries"] == 1
+        assert stats["cache"]["generation"] == 0
+
+        same = tmp_path / "same.npz"
+        save_weights(params, same)
+        status, _, body = _request(
+            port, "POST", "/admin/reload",
+            body=json.dumps({"weights": str(same)}).encode(),
+        )
+        assert status == 200 and json.loads(body)["reloaded"] is True
+        s3, h3, b3 = _request(port, "POST", "/enhance", body=payload)
+        assert s3 == 200 and h3.get("X-Cache") == "miss", (
+            "reload must invalidate the cache"
+        )
+        assert b3 == b1  # identical weights: identical recompute
+        _, stats = _get_json(port, "/stats")
+        assert stats["cache"]["generation"] == 1
+        status, _, body = _request(port, "GET", "/metrics")
+        assert status == 200
+        assert b"waternet_response_cache_hits_total 1" in body
+        assert b"waternet_response_cache_enabled 1" in body
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+def test_cacheless_server_byte_identity_to_pr16(engine, rng):
+    """response_cache=0 (the default): no X-Cache header on any answer
+    — the response is byte-identical to the pre-reuse front door."""
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, replicas=1, max_queue=64,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        payload = _png(
+            np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        )
+        for _ in range(2):
+            status, headers, _ = _request(
+                srv.bound_port, "POST", "/enhance", body=payload
+            )
+            assert status == 200
+            assert "X-Cache" not in headers
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+@pytest.mark.slow  # ~25 s saturation (two warmed tiers + a held fault): the store
+# policy's cheap side — hit/miss/invalidate — stays tier-1 above
+def test_downgraded_answer_is_never_cached(
+    params, student_params, rng, monkeypatch,
+):
+    """Brown-out policy correctness: a downgraded (fast-tier) answer to
+    an opted-in quality request is NOT stored, so a later non-opt-in
+    quality request with the same bytes misses the cache and gets a
+    genuine quality answer — never the downgraded replay."""
+    import cv2
+
+    from waternet_tpu.inference_engine import InferenceEngine, StudentEngine
+
+    fast = StudentEngine(params=student_params)
+    quality_engine = InferenceEngine(params=params)
+    srv = ServingServer(
+        quality_engine, BucketLadder([BUCKET]), max_batch=8,
+        max_wait_ms=30, replicas=1, max_queue=64, admit_watermark=3,
+        fast_engine=fast, supervision=_sup(), response_cache=8,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        port = srv.bound_port
+        bgr = np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8)
+        ok, buf = cv2.imencode(".png", bgr)
+        assert ok
+        payload = buf.tobytes()
+        # The saturating posts carry DIFFERENT bytes than the probe, so
+        # their (legitimate, quality-tier) answers cannot mask whether
+        # the downgraded probe answer leaked into the cache.
+        ok, buf = cv2.imencode(
+            ".png",
+            np.asarray(rng.integers(0, 256, (24, 26, 3)), dtype=np.uint8),
+        )
+        assert ok
+        filler = buf.tobytes()
+
+        def post(headers=None, out=None, key=None, body=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+            try:
+                conn.request(
+                    "POST", "/enhance", body=body or payload,
+                    headers=headers or {},
+                )
+                resp = conn.getresponse()
+                result = (resp.status, dict(resp.getheaders()), resp.read())
+                if out is not None:
+                    out[key] = result
+                return result
+            finally:
+                conn.close()
+
+        # Saturate the quality tier deterministically (same trick as
+        # test_fault_isolation): hold the first batch in flight so the
+        # queue sits at the admit watermark.
+        monkeypatch.setenv("WATERNET_FAULT_SLOW_SEC", "4.0")
+        faults.install(faults.FaultPlan.parse("slow_replica@1"))
+        held = {}
+        posters = [
+            threading.Thread(target=post, args=({}, held, i, filler))
+            for i in range(3)
+        ]
+        for t in posters:
+            t.start()
+        _wait_for(
+            lambda: _get_json(port, "/stats")[1]["queue_depth"] >= 3,
+            timeout=30, what="queue depth at the watermark",
+        )
+        status, headers, down_body = post({"X-Tier-Allow-Downgrade": "1"})
+        assert status == 200
+        assert headers.get("X-Tier-Served") == "fast"
+        assert headers.get("X-Cache") == "miss"
+        for t in posters:
+            t.join(60)
+        assert all(held[i][0] == 200 for i in range(3))
+        faults.clear()
+
+        # Same bytes, no opt-in, load gone: MUST miss (the downgraded
+        # answer was never stored) and serve the real quality tier.
+        status, headers, q_body = post()
+        assert status == 200
+        assert headers.get("X-Cache") == "miss", (
+            "downgraded answer leaked into the cache"
+        )
+        assert headers.get("X-Tier-Served") == "quality"
+        h, w = bgr.shape[:2]
+        offline = ten2arr(
+            quality_engine.enhance_padded_async(
+                [bgr[:, :, ::-1]], BUCKET, n_slots=8
+            )
+        )[0, :h, :w]
+        got = cv2.cvtColor(
+            cv2.imdecode(np.frombuffer(q_body, np.uint8), cv2.IMREAD_COLOR),
+            cv2.COLOR_BGR2RGB,
+        )
+        np.testing.assert_array_equal(got, offline)
+        assert q_body != down_body
+        # And the quality answer IS cached: next identical request hits.
+        status, headers, q2 = post()
+        assert headers.get("X-Cache") == "hit" and q2 == q_body
+    finally:
+        faults.clear()
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+def test_loadgen_counts_cache_hits_closed_loop(engine, rng):
+    """run_load counts 200s stamped X-Cache: hit — the closed-loop half
+    of satellite 1."""
+    srv = ServingServer(
+        engine, BucketLadder([BUCKET]), max_batch=MAX_BATCH,
+        max_wait_ms=5, replicas=1, max_queue=64, response_cache=8,
+    )
+    srv.start_background()
+    srv.wait_ready()
+    try:
+        payload = _png(
+            np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
+        )
+        report = run_load(
+            srv.url, [payload], concurrency=1, total=4,
+        )
+        assert report["ok"] == 4
+        assert report["cache_hits"] == 3  # first is the miss
+    finally:
+        srv.request_drain()
+        assert srv.join() == 0
+
+
+def test_fleet_router_cache_wiring(tmp_path):
+    """The router-level cache surfaces without spawning workers: the
+    summary's response_cache block, the fleet Prometheus metrics, and
+    the disabled default."""
+    from waternet_tpu.serving.fleet import FleetRouter, render_fleet_prometheus
+
+    plain = FleetRouter(["true"], n_workers=1, heartbeat_root=tmp_path)
+    block = plain.summary()["fleet"]["response_cache"]
+    assert block == empty_cache_block()
+    assert "waternet_fleet_response_cache_enabled 0" in (
+        render_fleet_prometheus(plain.summary())
+    )
+
+    cached = FleetRouter(
+        ["true"], n_workers=1, heartbeat_root=tmp_path, response_cache=4,
+    )
+    key = cached.response_cache.key(b"img", "quality")
+    cached.response_cache.put(
+        key, ("image/png", (("X-Tier-Served", "quality"),), b"bytes")
+    )
+    assert cached.response_cache.get(key) is not None
+    block = cached.summary()["fleet"]["response_cache"]
+    assert block["enabled"] is True
+    assert block["hits"] == 1 and block["entries"] == 1
+    text = render_fleet_prometheus(cached.summary())
+    assert "waternet_fleet_response_cache_hits_total 1" in text
+    assert "waternet_fleet_response_cache_enabled 1" in text
+    # /admin/reload invalidates the router cache too.
+    assert cached.response_cache.invalidate() == 1
+    assert cached.response_cache.get(key) is None
+
+
+# ---------------------------------------------------------------------------
+# Bench contract line (slow: runs two full stream loads on a live server)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_stream_reuse_contract_line():
+    """The stream_reuse_fps A/B end-to-end at CPU smoke sizes: on a
+    75%-static mix the reuse arm's effective fps is >= 2x the
+    always-compute control, the flicker index stays within the pinned
+    bound of the control (reuse replays identical bytes, so the delta
+    is ~0), and the client/server cross-accounting holds with the
+    reused bucket included."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    line = bench.bench_stream_reuse(
+        max_batch=2, max_buckets=1, base_hw=24, streams=2, frames=12,
+        static_pct=75,
+    )
+    assert line["metric"] == "stream_reuse_fps"
+    assert line["unit"] == "fps/stream"
+    assert line["value"] > 0
+    assert line["accounted"] is True, line
+    assert line["frames_reused"] > 0
+    assert line["reuse_rate"] >= 0.5, line
+    assert line["effective_fps_multiplier"] >= 2.0, line
+    assert abs(line["flicker_index_delta"]) <= 1.0, line
+    assert line["static_pct"] == 75
+    assert {"control_fps_per_stream", "flicker_index_control",
+            "flicker_index_reuse", "compiles"} <= set(line)

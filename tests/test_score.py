@@ -38,6 +38,7 @@ def uieb_root(tmp_path_factory):
     return root
 
 
+@pytest.mark.slow  # ~38 s: nr_mode + nr_native mixed-shapes keep the score CLI fast
 def test_score_paired_roundtrip(weights_file, uieb_root, tmp_path):
     import score as cli
 
@@ -219,6 +220,8 @@ def test_nr_native_header_decoder_disagreement(weights_file, tmp_path, rng, monk
     assert json.loads(out.read_text())["images"] == 3
 
 
+@pytest.mark.slow  # ~28 s full-CLI roundtrip; synthetic-val determinism also rides
+# the trainer parity pins
 def test_synth_export_roundtrip(weights_file, tmp_path):
     """tools/synth_export.py writes the EXACT pairs the trainer's synthetic
     val split saw (PNG is lossless; pairs are deterministic in

@@ -406,7 +406,7 @@ def test_stats_schema_and_latency_percentiles():
         "recovery_sec_max", "replica_health", "queue_depth",
         "queue_depth_mean", "queue_depth_max", "replicas",
         "images_per_sec", "load_imbalance", "tiers", "streams",
-        "per_replica", "window", "slo",
+        "cache", "per_replica", "window", "slo",
     }
     # Sliding-window restatement (docs/OBSERVABILITY.md "Windows &
     # SLOs"): just-recorded latencies are in the 60 s window, quantiles
@@ -422,11 +422,18 @@ def test_stats_schema_and_latency_percentiles():
     # on a server that never opened a session, live gauges default-safe.
     assert set(summary["streams"]) == {
         "opened", "refused", "frames_in", "frames_delivered",
-        "frames_dropped", "frames_out_of_budget", "downgrades",
-        "active_streams", "per_session_p99_ms", "frame_latency_ms",
+        "frames_reused", "frames_dropped", "frames_out_of_budget",
+        "downgrades", "active_streams", "per_session_p99_ms",
+        "frame_latency_ms",
     }
     assert summary["streams"]["active_streams"] == 0
     assert summary["streams"]["per_session_p99_ms"] == {}
+    # Response-cache block (docs/SERVING.md "Temporal reuse & response
+    # cache"): all-zeros disabled block without a registered cache.
+    assert summary["cache"] == {
+        "enabled": False, "hits": 0, "misses": 0, "evictions": 0,
+        "entries": 0, "capacity": 0, "generation": 0,
+    }
     # Fault-isolation counters (docs/SERVING.md "Fault isolation").
     assert summary["retried"] == 2
     assert summary["downgraded"] == 1
@@ -968,6 +975,7 @@ def test_bench_serving_multi_scales_on_multicore():
      ("train_chaos", "chaos_train_images_per_sec"),
      ("tiers", "fast_tier_images_per_sec"),
      ("stream", "video_stream_fps"),
+     ("stream_reuse", "stream_reuse_fps"),
      ("obs", "obs_overhead_pct")],
 )
 def test_bench_serve_fail_line_keeps_own_metric(config, metric):

@@ -354,13 +354,13 @@ def test_stream_window_drop_oldest_under_stalled_consumer(server, rng):
 
 def test_stalled_stream_never_delays_healthy_stream(server, rng):
     """The stall-isolation acceptance pin: a wedged consumer (every one
-    of its deliveries stalls 0.3 s) backpressures ONLY its own session.
+    of its deliveries stalls 1 s) backpressures ONLY its own session.
     A healthy stream running concurrently keeps real-time latency — its
     p99 stays far under the stalled session's multi-second delivery
     tail, which a shared/serialized delivery path could not do."""
     rgb = np.asarray(rng.integers(0, 256, (30, 30, 3)), dtype=np.uint8)
     payload = _png(rgb)
-    os.environ["WATERNET_FAULT_STALL_SEC"] = "0.3"
+    os.environ["WATERNET_FAULT_STALL_SEC"] = "1.0"
     faults.install(faults.FaultPlan.parse("stream_stall@1"))
     try:
         # Session 1: the stalled victim (we do not read until the end).
@@ -377,16 +377,19 @@ def test_stalled_stream_never_delays_healthy_stream(server, rng):
             budget_ms=5000.0, window=8,
         )
         _send_end(sock)
-        recs = _read_records(f)  # ~0.3 s per record: the stall is real
+        recs = _read_records(f)  # ~1 s per record: the stall is real
         sock.close()
     finally:
         faults.clear()
         os.environ.pop("WATERNET_FAULT_STALL_SEC", None)
     assert report["ok"] == 6, report
     assert report["conn_reset"] == 0 and report["errors"] == 0
-    # Healthy p99 bounded well under the stalled session's >= 1.8 s
-    # delivery tail: the stall did not leak across sessions.
-    assert report["frame_latency_ms"]["p99"] < 1000.0, report
+    # Healthy p99 bounded well under the stalled session's >= 6 s
+    # delivery tail: the stall did not leak across sessions. The bound
+    # leaves room for single-core compute contention (both sessions'
+    # frames share one replica here) while staying far below what a
+    # shared/serialized delivery path would show (>= seconds of stall).
+    assert report["frame_latency_ms"]["p99"] < 3000.0, report
     # The stalled session itself still accounted every frame.
     z = _summary_record(recs)
     assert z["delivered"] + z["dropped"] == 6

@@ -111,6 +111,8 @@ def _tiny_batches(n=2, hw=32, bs=4):
     return list(ds.batches(np.arange(n * bs), bs, shuffle=False))
 
 
+@pytest.mark.slow  # ~28 s: train_metrics_finite/eval_metrics step the same engine
+# fast; the distill smoke pins a falling loss tier-1
 def test_train_loss_decreases(tiny_engine):
     batches = _tiny_batches(1)
     losses = []
@@ -133,6 +135,8 @@ def test_eval_metrics(tiny_engine):
     assert np.isfinite(m["mse"])
 
 
+@pytest.mark.slow  # ~32 s: device_cached_under_spatial_sharding keeps sharded
+# training pinned fast; full dp×sp parity lives in the slow tier
 def test_spatially_sharded_train_step_matches_dp():
     """2x4 (data x spatial) mesh training == 8x1 pure-DP training: XLA's
     SPMD partitioner must make the H-sharding annotation semantics-free."""
@@ -156,7 +160,7 @@ def test_spatially_sharded_train_step_matches_dp():
         np.testing.assert_allclose(m_dp[k], m_sp[k], rtol=2e-4, err_msg=k)
 
 
-@pytest.mark.slow  # ~45 s: the fast representative is the non-perceptual dp×sp parity above
+@pytest.mark.slow  # ~45 s: the non-perceptual dp×sp parity above (also slow) is the base
 def test_spatially_sharded_train_step_matches_dp_with_perceptual():
     """Same dp×sp == dp invariant with the VGG perceptual term ON.
 
@@ -193,6 +197,8 @@ def test_spatially_sharded_train_step_matches_dp_with_perceptual():
         np.testing.assert_allclose(m_dp[k], m_sp[k], rtol=5e-4, err_msg=k)
 
 
+@pytest.mark.slow  # ~49 s: manager retention + train_cli resume + resume_auto
+# fallback keep the checkpoint contract fast
 def test_checkpoint_restore_roundtrip(tiny_engine, tmp_path):
     tiny_engine.train_epoch(iter(_tiny_batches(1)), epoch=0)
     step_before = int(tiny_engine.state.step)
@@ -212,6 +218,8 @@ def test_checkpoint_restore_roundtrip(tiny_engine, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # ~46 s: tail_batch_masked + device_cached_under_spatial_sharding
+# + val-cache aliasing keep the HBM-resident path pinned fast
 def test_device_cached_epoch_matches_host_fed():
     """The HBM-resident dataset path must be math-identical to the host-fed
     path: same augmentation RNG stream, same Philox shuffle stream (so the
@@ -318,6 +326,8 @@ def test_val_cache_not_aliased_across_datasets():
     assert vid not in _CACHE_TOKENS
 
 
+@pytest.mark.slow  # ~32 s: the precache hoist parity family (histeq/VGG/eval) all
+# ride the slow tier; device-cache parity reps stay tier-1
 def test_precache_histeq_matches_in_step_transform():
     """precache_histeq=True (transforms hoisted to cache-build time, CLAHE
     via the dihedral variant table) must train identically to the in-step
@@ -399,7 +409,7 @@ def test_device_cached_matches_host_fed_under_spatial_sharding():
         )
 
 
-@pytest.mark.slow  # ~2 min: the histeq precache parity above pins the same hoist fast
+@pytest.mark.slow  # ~2 min: the histeq precache parity above (also slow) is the cheap pin
 def test_precache_vgg_ref_matches_in_step():
     """precache_vgg_ref=True (the perceptual ref branch's VGG forward
     hoisted to cache-build time, gathered per step by [variant, item])

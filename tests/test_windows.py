@@ -426,6 +426,24 @@ def test_bench_history_regression_gate(tmp_path, capsys):
     assert "r04*" in out  # stale rounds are visibly starred
 
 
+def test_bench_history_stream_reuse_fps_direction(tmp_path, capsys):
+    """stream_reuse_fps is a throughput contract line: higher-better
+    for the regression gate (a drop flags, a rise never does)."""
+    from tools import bench_history
+
+    assert bench_history.metric_direction("stream_reuse_fps") == 1
+    assert bench_history.metric_direction("video_stream_fps") == 1
+    _write_round(tmp_path, 1, {"metric": "stream_reuse_fps",
+                               "value": 40.0, "reuse_rate": 0.8})
+    _write_round(tmp_path, 2, {"metric": "stream_reuse_fps",
+                               "value": 20.0, "reuse_rate": 0.8})
+    assert bench_history.main(
+        ["--root", str(tmp_path), "--threshold-pct", "10"]
+    ) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSIONS" in out and "value" in out
+
+
 def test_bench_history_all_error_rounds_rc0(tmp_path, capsys):
     """The committed repo state today: every round is an error round
     (chip unreachable). That is a tunnel problem, not a perf regression

@@ -19,9 +19,11 @@ snapshot, and nobody reads five of them side by side. This tool does:
   that stopped passing is a regression too.
 
 Direction heuristic: throughput-ish names (``per_sec``, ``mfu``,
-``vs_baseline``, ``reduction``, ``occupancy``) are higher-better;
-cost-ish suffixes (``_ms``, ``_pct``, ``_sec``, ``_bytes``) are
-lower-better; anything else is informational (never flagged).
+``vs_baseline``, ``reduction``, ``occupancy``, ``fps`` — incl. the
+stream contract lines ``video_stream_fps`` / ``stream_reuse_fps``) are
+higher-better; cost-ish suffixes (``_ms``, ``_pct``, ``_sec``,
+``_bytes``) are lower-better; anything else is informational (never
+flagged).
 
 Pure stdlib, no jax — runnable on any host that has the checkouts.
 """
@@ -39,7 +41,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 #: Metric-name fragments that mean "bigger is better".
 _HIGHER = ("per_sec", "mfu", "vs_baseline", "reduction", "occupancy",
-           "images_per")
+           "images_per", "fps")
 #: Name suffixes that mean "smaller is better".
 _LOWER = ("_ms", "_pct", "_sec", "_bytes", "_overhead")
 

@@ -200,6 +200,9 @@ def render_prometheus(summary: dict) -> str:
           "Frames read off stream sockets.", s["frames_in"])
     w.one("waternet_stream_frames_delivered_total", "counter",
           "Frames delivered downstream.", s["frames_delivered"])
+    w.one("waternet_stream_frames_reused_total", "counter",
+          "Frames answered from the cached enhanced frame by temporal "
+          "gating (never computed).", s.get("frames_reused", 0))
     w.one("waternet_stream_frames_dropped_total", "counter",
           "Frames dropped by window enforcement.", s["frames_dropped"])
     w.one("waternet_stream_frames_out_of_budget_total", "counter",
@@ -221,6 +224,27 @@ def render_prometheus(summary: dict) -> str:
         [({"quantile": "0.5"}, s["frame_latency_ms"]["p50"]),
          ({"quantile": "0.99"}, s["frame_latency_ms"]["p99"])],
     )
+
+    # --- /enhance response cache (PR 17; .get keeps older summaries legal)
+    cache = summary.get("cache")
+    if cache:
+        w.one("waternet_response_cache_enabled", "gauge",
+              "1 when the content-addressed /enhance cache is armed.",
+              cache["enabled"])
+        w.one("waternet_response_cache_hits_total", "counter",
+              "Responses replayed from the content-addressed cache.",
+              cache["hits"])
+        w.one("waternet_response_cache_misses_total", "counter",
+              "Cache lookups that fell through to compute.",
+              cache["misses"])
+        w.one("waternet_response_cache_evictions_total", "counter",
+              "Entries evicted by the LRU capacity bound.",
+              cache["evictions"])
+        w.one("waternet_response_cache_entries", "gauge",
+              "Entries currently cached.", cache["entries"])
+        w.one("waternet_response_cache_generation", "gauge",
+              "Params generation (bumped by each /admin/reload "
+              "invalidation).", cache["generation"])
 
     per_replica = summary["per_replica"]
     w.metric(

@@ -76,6 +76,7 @@ from waternet_tpu.resilience.heartbeat import (
     heartbeat_path,
     read_heartbeat,
 )
+from waternet_tpu.serving.reuse import ResponseCache, empty_cache_block
 
 __all__ = [
     "FleetPolicy",
@@ -99,7 +100,7 @@ _REASONS = {
 #: client ledger splits on must all survive the extra hop.
 _RELAY_HEADERS = (
     "content-type", "retry-after", "x-request-id", "x-tier-served",
-    "x-worker-id",
+    "x-worker-id", "x-cache",
 )
 
 #: Request headers forwarded to the chosen worker (everything the
@@ -107,6 +108,7 @@ _RELAY_HEADERS = (
 _FORWARD_HEADERS = (
     "content-type", "x-request-id", "x-tier", "x-tier-allow-downgrade",
     "x-deadline-ms", "x-stream-window", "x-stream-fps",
+    "x-stream-reuse", "x-stream-max-reuse-run", "x-stream-reuse-warp",
 )
 
 
@@ -442,6 +444,7 @@ class FleetRouter:
         backoff_base_sec: float = 0.25,
         backoff_cap_sec: float = 5.0,
         ring_vnodes: int = 64,
+        response_cache: int = 0,
         clock=None,
     ):
         if n_workers < 1:
@@ -489,6 +492,17 @@ class FleetRouter:
         )
         self._policy = FleetPolicy(
             self.n_workers, self.max_workers, cooldown_sec=scale_cooldown_sec
+        )
+        # Router-level content-addressed /enhance cache. Keys include a
+        # ladder identity of "fleet" rather than the bucket ladder (the
+        # router never sees it); invalidated when /admin/reload is
+        # broadcast through this front door. Only answers served at the
+        # exact requested tier are stored, so a brown-out downgrade can
+        # never be replayed to a non-opt-in client.
+        self.response_cache = (
+            ResponseCache(int(response_cache), ladder_id="fleet")
+            if response_cache
+            else None
         )
         self._hb_root = Path(
             heartbeat_root
@@ -979,6 +993,11 @@ class FleetRouter:
                 "recovery_sec_max": round(self._recovery_max, 3),
                 "brownout": self._brownout,
                 "ring": self._ring.members(),
+                "response_cache": (
+                    self.response_cache.counters()
+                    if self.response_cache is not None
+                    else empty_cache_block()
+                ),
             }
             workers = {
                 w.worker_id: w.summary() for w in self._workers.values()
@@ -1223,6 +1242,17 @@ class FleetRouter:
                 await self._enhance(path, headers, body, writer, req_id)
                 and not want_close
             )
+        if path == "/admin/reload":
+            if method != "POST":
+                return self._json(
+                    writer, 405,
+                    {"error": 'POST {"weights": path} to /admin/reload'},
+                    extra=rid,
+                )
+            return (
+                await self._admin_reload(headers, body, writer, req_id)
+                and not want_close
+            )
         return self._json(writer, 404, {"error": f"no route {path}"},
                           extra=rid)
 
@@ -1383,6 +1413,22 @@ class FleetRouter:
             except ValueError:
                 budget_ms = None  # forwarded anyway; the worker 400s it
         t0 = time.monotonic()
+        cache_key = None
+        if self.response_cache is not None:
+            tier = headers.get("x-tier", "quality").strip().lower()
+            cache_key = self.response_cache.key(body, tier)
+            cached = self.response_cache.get(cache_key)
+            if cached is not None:
+                # Replay the stored worker answer without touching a
+                # worker. Cached relay headers were stripped of the
+                # original X-Request-Id / X-Cache at store time, so the
+                # replay carries this request's id and a "hit" stamp.
+                c_ctype, c_relay, c_body = cached
+                self._windows.observe(200, (time.monotonic() - t0) * 1e3)
+                return self._respond(
+                    writer, 200, c_body, ctype=c_ctype,
+                    extra=c_relay + (("X-Cache", "hit"),) + rid,
+                )
         with self._lock:
             self._inflight += 1
         tried: set = set()
@@ -1418,11 +1464,33 @@ class FleetRouter:
                 latency_ms = (time.monotonic() - t0) * 1e3
                 self._windows.observe(status, latency_ms)
                 self._account_relay(w, status)
+                if cache_key is not None and status == 200:
+                    served = next(
+                        (v for n, v in relay if n == "X-Tier-Served"), None
+                    )
+                    # Same policy as the worker cache: only answers
+                    # served at the exact requested tier are stored, so
+                    # a brown-out downgrade is never replayed later.
+                    if served is not None and served.strip().lower() == \
+                            headers.get("x-tier", "quality").strip().lower():
+                        stored_relay = tuple(
+                            (n, v) for n, v in relay
+                            if n not in ("X-Request-Id", "X-Cache")
+                        )
+                        self.response_cache.put(
+                            cache_key, (ctype, stored_relay, resp_body)
+                        )
+                extra = relay
+                if cache_key is not None and not any(
+                        n == "X-Cache" for n, _ in extra):
+                    # Router cache enabled but this answer came from a
+                    # worker (and the worker didn't stamp its own cache
+                    # state): stamp the router-level miss.
+                    extra = extra + (("X-Cache", "miss"),)
+                if not any(n == "X-Request-Id" for n, _ in extra):
+                    extra = extra + rid
                 return self._respond(
-                    writer, status, resp_body, ctype=ctype,
-                    extra=relay + rid
-                    if not any(n == "X-Request-Id" for n, _ in relay)
-                    else relay,
+                    writer, status, resp_body, ctype=ctype, extra=extra,
                 )
             # Out of candidates (or retries): the router answers, id
             # echoed, so the client's correlation never dangles.
@@ -1442,6 +1510,56 @@ class FleetRouter:
         finally:
             with self._lock:
                 self._inflight -= 1
+
+    async def _admin_reload(self, headers, body, writer, req_id) -> bool:
+        """Broadcast ``POST /admin/reload`` to every ready worker, then
+        invalidate the router response cache. The aggregate answer is
+        200 only when every ready worker reloaded; per-worker replies
+        are included so a mixed fleet is diagnosable from one call.
+        Cache invalidation happens even on partial failure — a stale
+        replay is worse than a redundant recompute."""
+        rid = (("X-Request-Id", req_id),)
+        if self.draining.is_set():
+            return self._json(
+                writer, 503, {"error": "draining"}, extra=rid, close=True,
+            )
+        with self._lock:
+            workers = [
+                w for w in self._workers.values()
+                if w.ready and not w.failed and not w.retiring
+            ]
+        if not workers:
+            return self._json(
+                writer, 503, {"error": "no healthy worker"},
+                extra=(("Retry-After", "1"),) + rid,
+            )
+        results = {}
+        all_ok = True
+        for w in workers:
+            answer = await self._relay_enhance(
+                w, "/admin/reload", headers, body, req_id
+            )
+            if answer is None:
+                results[w.worker_id] = {"error": "relay failed"}
+                all_ok = False
+                continue
+            status, _ctype, _relay, resp_body = answer
+            try:
+                payload = json.loads(resp_body) if resp_body else {}
+            except ValueError:
+                payload = {"error": "unparseable worker reply"}
+            if not isinstance(payload, dict):
+                payload = {"reply": payload}
+            payload["status"] = status
+            results[w.worker_id] = payload
+            all_ok = all_ok and status == 200
+        if self.response_cache is not None:
+            self.response_cache.invalidate()
+        return self._json(
+            writer, 200 if all_ok else 502,
+            {"reloaded": all_ok, "workers": results},
+            extra=rid,
+        )
 
     # -- /stream relay -------------------------------------------------
 
@@ -1575,6 +1693,23 @@ def render_fleet_prometheus(summary: dict) -> str:
     metric("waternet_fleet_recovery_sec_max", "gauge",
            "Slowest failure-to-ready worker recovery",
            [(None, fleet["recovery_sec_max"])])
+    cache = fleet.get("response_cache")
+    if cache:
+        metric("waternet_fleet_response_cache_enabled", "gauge",
+               "1 when the router content-addressed /enhance cache is on",
+               [(None, 1 if cache["enabled"] else 0)])
+        metric("waternet_fleet_response_cache_hits_total", "counter",
+               "Router /enhance answers replayed from cache",
+               [(None, cache["hits"])])
+        metric("waternet_fleet_response_cache_misses_total", "counter",
+               "Router /enhance cache lookups that fell through",
+               [(None, cache["misses"])])
+        metric("waternet_fleet_response_cache_evictions_total", "counter",
+               "Router cache entries evicted by the LRU bound",
+               [(None, cache["evictions"])])
+        metric("waternet_fleet_response_cache_entries", "gauge",
+               "Router cache entries currently held",
+               [(None, cache["entries"])])
     metric(
         "waternet_fleet_worker_relay_total", "counter",
         "Relayed answers per worker, by outcome",
@@ -1727,6 +1862,13 @@ def parse_args(argv=None):
     )
     parser.add_argument("--max-restarts", type=int, default=5)
     parser.add_argument(
+        "--response-cache", type=int, default=0, metavar="N",
+        help="Router-level content-addressed /enhance response cache "
+        "holding up to N answers (0 = off, the default). Keys include "
+        "the requested tier; only full-tier answers are stored, and "
+        "/admin/reload through the router invalidates everything.",
+    )
+    parser.add_argument(
         "worker_args", nargs=argparse.REMAINDER,
         help="Arguments after -- go to every waternet-serve worker.",
     )
@@ -1765,6 +1907,7 @@ def main(argv=None) -> int:
         heartbeat_root=args.heartbeat_dir,
         worker_faults=_parse_worker_faults(args.worker_faults),
         max_restarts=args.max_restarts,
+        response_cache=args.response_cache,
     )
     return router.run(install_signal_handlers=True)
 

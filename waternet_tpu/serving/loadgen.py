@@ -116,7 +116,10 @@ def run_load(
     as ``X-Tier``; ``allow_downgrade=True`` sets
     ``X-Tier-Allow-Downgrade: 1`` (the brown-out opt-in) and the report's
     ``downgraded`` counts 200s whose ``X-Tier-Served`` differs from the
-    requested tier.
+    requested tier. ``cache_hits`` counts 200s stamped ``X-Cache: hit``
+    — answers replayed from a content-addressed response cache
+    (docs/SERVING.md "Temporal reuse & response cache"); always 0
+    against a cache-less server.
 
     Every request carries a unique ``X-Request-Id`` (``lg-<run>-<i>``),
     which the server echoes and stamps on its trace spans
@@ -145,7 +148,7 @@ def run_load(
     lock = threading.Lock()
     counts = {
         "ok": 0, "shed": 0, "deadline_expired": 0, "rejected": 0,
-        "conn_reset": 0, "errors": 0, "downgraded": 0,
+        "conn_reset": 0, "errors": 0, "downgraded": 0, "cache_hits": 0,
     }
     latencies: List[float] = []
     samples: List = []  # (t_done - t0, latency_sec) for ok requests
@@ -219,6 +222,7 @@ def run_load(
                     status = resp.status
                     served = resp.getheader("X-Tier-Served", "")
                     wid = resp.getheader("X-Worker-Id", "") or None
+                    cache_hit = resp.getheader("X-Cache", "") == "hit"
                     closed = (
                         resp.getheader("Connection", "").lower() == "close"
                     )
@@ -256,6 +260,11 @@ def run_load(
                 with lock:
                     if status == 200:
                         counts["ok"] += 1
+                        if cache_hit:
+                            # Content-addressed response cache answered
+                            # (X-Cache: hit) — still an ok, also tallied
+                            # so closed-loop runs can report hit rate.
+                            counts["cache_hits"] += 1
                         record_worker(wid, "ok")
                         latencies.append(dt)
                         samples.append((t1 - t_run0, dt))
@@ -341,6 +350,7 @@ def run_load(
 _FRAME_LEN = struct.Struct("!I")
 _REC_HEAD = struct.Struct("!cBII")
 _FLAG_DOWNGRADED = 1
+_FLAG_REUSED = 2
 
 
 def _read_exact(f, n: int) -> Optional[bytes]:
@@ -368,6 +378,10 @@ def run_stream_load(
     timeout: float = 120.0,
     window_sec: float = DEFAULT_WINDOW_SEC,
     per_worker: bool = False,
+    reuse_threshold: Optional[float] = None,
+    max_reuse_run: Optional[int] = None,
+    reuse_warp: bool = False,
+    keep_frames: bool = False,
 ) -> Dict:
     """Replay ``payloads`` as ``streams`` paced concurrent POST /stream
     sessions (``frames`` frames each at ``fps``); returns the aggregate
@@ -390,6 +404,19 @@ def run_stream_load(
     adds ``per_worker_sessions`` — accepted sessions counted by the
     ``X-Worker-Id`` on the response head, pinning which fleet worker
     each session landed on (docs/SERVING.md "Fleet").
+
+    ``reuse_threshold`` opts the sessions into server-side temporal
+    reuse (``X-Stream-Reuse``, docs/SERVING.md "Temporal reuse"):
+    near-static frames come back as reuse records (wire kind ``R``),
+    counted in ``reused`` — delivered answers that skipped compute, so
+    the effective rate is ``(ok + reused)`` per stream-second and
+    ``fps_per_stream`` counts both. ``max_reuse_run`` forwards the
+    staleness cap (``X-Stream-Max-Reuse-Run``) and ``reuse_warp``
+    enables coarse motion-compensated reuse (``X-Stream-Reuse-Warp``).
+    ``keep_frames=True`` additionally returns ``frames`` — per stream
+    index, the ordered ``(seq, kind, payload_bytes)`` of every
+    delivered frame — so a bench can measure flicker on exactly what a
+    viewer would see.
     """
     import socket
 
@@ -398,14 +425,16 @@ def run_stream_load(
     run_tag = new_request_id()[:8]
     lock = threading.Lock()
     counts = {
-        "ok": 0, "dropped": 0, "out_of_budget": 0, "frame_errors": 0,
-        "downgraded": 0, "refused": 0, "conn_reset": 0, "errors": 0,
+        "ok": 0, "reused": 0, "dropped": 0, "out_of_budget": 0,
+        "frame_errors": 0, "downgraded": 0, "refused": 0, "conn_reset": 0,
+        "errors": 0,
     }
     totals = {"frames_sent": 0}
     latencies: List[float] = []
     samples: List = []  # (t_recv - t_run0, latency_sec) delivered frames
     failures: List[Dict] = []
     session_workers: Dict[str, int] = {}  # X-Worker-Id -> sessions
+    frames_out: Dict[int, List] = {}  # stream idx -> [(seq, kind, bytes)]
 
     def record_failure(rec: Dict) -> None:
         # Caller holds `lock`.
@@ -435,6 +464,12 @@ def run_stream_load(
                 head += f"X-Tier: {tier}\r\n"
             if allow_downgrade:
                 head += "X-Tier-Allow-Downgrade: 1\r\n"
+            if reuse_threshold is not None:
+                head += f"X-Stream-Reuse: {reuse_threshold}\r\n"
+            if max_reuse_run is not None:
+                head += f"X-Stream-Max-Reuse-Run: {max_reuse_run}\r\n"
+            if reuse_warp:
+                head += "X-Stream-Reuse-Warp: 1\r\n"
             head += "\r\n"
             sock.sendall(head.encode("latin-1"))
             f = sock.makefile("rb")
@@ -508,14 +543,18 @@ def run_stream_load(
                         break
                     with lock:
                         accounted += 1
-                        if kind == b"F":
-                            counts["ok"] += 1
+                        if kind in (b"F", b"R"):
+                            counts["ok" if kind == b"F" else "reused"] += 1
                             if flags & _FLAG_DOWNGRADED:
                                 counts["downgraded"] += 1
-                            if seq in t_sent:
+                            if kind == b"F" and seq in t_sent:
                                 latencies.append(t_recv - t_sent[seq])
                                 samples.append(
                                     (t_recv - t_run0, t_recv - t_sent[seq])
+                                )
+                            if keep_frames:
+                                frames_out.setdefault(si, []).append(
+                                    (seq, kind.decode("latin-1"), payload)
                                 )
                         elif kind == b"D":
                             reason = json.loads(payload).get("reason")
@@ -579,19 +618,25 @@ def run_stream_load(
     elapsed = time.perf_counter() - t_run0
 
     lat_sorted = sorted(latencies)
-    ok = counts["ok"]
+    # Delivered = computed + reused: a reuse record is a real answer on
+    # the wire, it just skipped the device. With reuse off (the
+    # default) reused is 0 and this is the old ok-only figure.
+    delivered = counts["ok"] + counts["reused"]
     per_worker_block = (
         {"per_worker_sessions": session_workers} if per_worker else {}
     )
+    frames_block = {"frames": frames_out} if keep_frames else {}
     return {
         **per_worker_block,
+        **frames_block,
         "streams": int(streams),
         "frames_per_stream": int(frames),
         "offered_fps": float(fps),
         **totals,
         **counts,
         "fps_per_stream": (
-            round(ok / max(1, int(streams)) / elapsed, 2) if elapsed else 0.0
+            round(delivered / max(1, int(streams)) / elapsed, 2)
+            if elapsed else 0.0
         ),
         "elapsed_sec": round(elapsed, 3),
         "frame_latency_ms": {
@@ -614,6 +659,59 @@ def _synthetic_payloads(spec: str, n: int = 8) -> List[bytes]:
     out = []
     for _ in range(n):
         img = rng.integers(0, 256, (h, w, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode(".png", img)
+        assert ok
+        out.append(buf.tobytes())
+    return out
+
+
+def _stream_payloads(
+    spec: str, n: int = 16, static_pct: int = 0, pan_px: int = 0,
+) -> List[bytes]:
+    """``HxW`` -> n deterministic PNG frames with a controlled
+    redundancy mix, the input for temporal-reuse benchmarking.
+
+    ``static_pct`` of the frames repeat their predecessor exactly (the
+    pattern is deterministic: frame ``i`` changes content only when
+    ``i * (100 - static_pct) // 100`` advances, so a 75%-static run is
+    the same frames every time). When the content does change it is a
+    fresh scene unless ``pan_px`` is set, in which case the scene pans
+    — ``np.roll`` by ``pan_px`` columns per change — which a
+    block-flow-warping gate can still reuse but a plain delta gate
+    treats as motion. With ``static_pct=0, pan_px=0`` every frame is an
+    independent scene (the always-compute control mix).
+    """
+    import cv2
+    import numpy as np
+
+    if not 0 <= int(static_pct) <= 100:
+        raise ValueError("static_pct must be in [0, 100]")
+    h, w = (int(x) for x in spec.lower().split("x"))
+    rng = np.random.default_rng(0)
+    # Structured base scene (smooth gradients + texture) rather than
+    # pure noise: block matching on noise is meaningless, and real
+    # camera frames are compressible structure, not static snow.
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    base = np.stack(
+        [
+            127 + 90 * np.sin(xx / 11.0) * np.cos(yy / 7.0),
+            127 + 90 * np.cos(xx / 5.0 + yy / 13.0),
+            rng.integers(0, 256, (h, w)).astype(np.float32),
+        ],
+        axis=-1,
+    ).clip(0, 255).astype(np.uint8)
+    out = []
+    img = base
+    fresh = (100 - int(static_pct))
+    for i in range(n):
+        changed = i == 0 or (i * fresh) // 100 != ((i - 1) * fresh) // 100
+        if changed and i > 0:
+            if pan_px:
+                img = np.roll(img, int(pan_px), axis=1)
+            else:
+                img = np.asarray(
+                    rng.integers(0, 256, (h, w, 3)), dtype=np.uint8
+                )
         ok, buf = cv2.imencode(".png", img)
         assert ok
         out.append(buf.tobytes())
@@ -699,6 +797,35 @@ def main(argv=None) -> int:
         help="Per-stream delivery window (X-Stream-Window); default: "
         "the server's --stream-window (--stream mode).",
     )
+    parser.add_argument(
+        "--static-pct", type=int, default=None, metavar="PCT",
+        help="Generate stream payloads where PCT%% of frames repeat "
+        "their predecessor exactly (deterministic redundancy mix for "
+        "temporal-reuse runs; --stream mode, replaces --synthetic "
+        "noise frames).",
+    )
+    parser.add_argument(
+        "--pan-px", type=int, default=0,
+        help="When the generated scene changes, pan it by this many "
+        "pixels instead of cutting to a fresh scene (exercises the "
+        "warp path; needs --static-pct).",
+    )
+    parser.add_argument(
+        "--reuse-threshold", type=float, default=None,
+        help="Opt into server-side temporal reuse at this frame-delta "
+        "threshold (X-Stream-Reuse); the report's 'reused' counts "
+        "answers served from the reuse gate (--stream mode).",
+    )
+    parser.add_argument(
+        "--max-reuse-run", type=int, default=None,
+        help="Staleness cap forwarded as X-Stream-Max-Reuse-Run: at "
+        "most N consecutive reused frames before a forced recompute.",
+    )
+    parser.add_argument(
+        "--reuse-warp", action="store_true", default=False,
+        help="Enable coarse motion-compensated reuse "
+        "(X-Stream-Reuse-Warp: 1) for slow pans.",
+    )
     args = parser.parse_args(argv)
 
     if args.source:
@@ -712,6 +839,11 @@ def main(argv=None) -> int:
         if not payloads:
             print(f"no images under {args.source}", file=sys.stderr)
             return 2
+    elif args.stream and args.static_pct is not None:
+        payloads = _stream_payloads(
+            args.synthetic, n=max(args.frames, 1),
+            static_pct=args.static_pct, pan_px=args.pan_px,
+        )
     else:
         payloads = _synthetic_payloads(args.synthetic)
     if args.stream:
@@ -727,6 +859,9 @@ def main(argv=None) -> int:
             allow_downgrade=args.allow_downgrade,
             window_sec=args.window_sec,
             per_worker=args.per_worker,
+            reuse_threshold=args.reuse_threshold,
+            max_reuse_run=args.max_reuse_run,
+            reuse_warp=args.reuse_warp,
         )
         print(json.dumps(report))
         return 0

@@ -61,6 +61,17 @@ for the traffic patterns a library never sees:
   overload. Stream admission is bounded by ``--max-streams``; the
   per-session delivery window by ``--stream-window``.
 
+* **Compute reuse** (waternet_tpu/serving/reuse.py, both off by
+  default). ``--stream-reuse-threshold`` arms per-stream temporal
+  gating: a frame whose cheap decimated delta against the last
+  computed frame is under threshold is answered from the cached
+  enhanced frame (an ``R`` record) without entering the batcher,
+  bounded by the ``--stream-max-reuse-run`` staleness cap.
+  ``--response-cache N`` arms a bounded LRU over rendered ``/enhance``
+  answers keyed on (payload digest, tier, bucket ladder, params
+  generation) — hits stamp ``X-Cache: hit``, reloads invalidate, and
+  downgraded answers are never stored.
+
 Endpoints: ``POST /enhance`` (image file bytes in, PNG out — the body
 is whatever ``cv2.imdecode`` reads, which is exactly what ``cv2.imread``
 reads on the local path, so the CLI and the service stay behaviorally
@@ -113,6 +124,7 @@ from waternet_tpu.serving.replicas import (
     ReplicaUnavailable,
     SupervisionConfig,
 )
+from waternet_tpu.serving.reuse import DEFAULT_MAX_REUSE_RUN, ResponseCache
 from waternet_tpu.serving.stats import ServingStats
 from waternet_tpu.serving.streams import StreamConfig, StreamManager
 
@@ -231,6 +243,9 @@ class ServingServer:
         max_streams: int = 8,
         stream_window: int = 8,
         slo: Optional[str] = None,
+        stream_reuse_threshold: Optional[float] = None,
+        stream_max_reuse_run: int = DEFAULT_MAX_REUSE_RUN,
+        response_cache: int = 0,
     ):
         if admit_watermark is None:
             # Shed before QueueFull would fire: the watermark is the soft
@@ -257,7 +272,29 @@ class ServingServer:
         self.downgrade_watermark = int(downgrade_watermark)
         self.max_streams = int(max_streams)
         self.stream_window = int(stream_window)
+        # Temporal reuse (docs/SERVING.md "Temporal reuse & response
+        # cache"): the server-wide default gating threshold (None = off;
+        # sessions override per connection with X-Stream-Reuse) and the
+        # staleness cap on consecutive reuses.
+        self.stream_reuse_threshold = (
+            None if stream_reuse_threshold is None
+            else float(stream_reuse_threshold)
+        )
+        self.stream_max_reuse_run = int(stream_max_reuse_run)
         self.stats = stats if stats is not None else ServingStats()
+        # Content-addressed /enhance response cache (0 entries = off).
+        # Keyed on (payload digest, tier, ladder identity, params
+        # generation); only never-downgraded answers are stored, so a
+        # hit is policy-correct for any requester of that tier.
+        self.response_cache = (
+            ResponseCache(
+                response_cache, ladder_id=",".join(ladder.describe())
+            )
+            if response_cache
+            else None
+        )
+        if self.response_cache is not None:
+            self.stats.cache_probe = self.response_cache.counters
         self.slo_spec = slo
         if slo:
             from waternet_tpu.obs.slo import SloEngine, parse_slo
@@ -787,6 +824,33 @@ class ServingServer:
                 )
             deadline = time.perf_counter() + budget_ms / 1e3
 
+        # Content-addressed response cache (docs/SERVING.md "Temporal
+        # reuse & response cache"; off unless --response-cache): a
+        # digest hit replays the stored PNG without admission, decode,
+        # or compute. The key's tier component plus the store-side
+        # downgrade filter make a hit policy-correct for any requester
+        # of that tier, opted in or not.
+        cache_key = None
+        if self.response_cache is not None:
+            cache_key = self.response_cache.key(body, tier)
+            cached = self.response_cache.get(cache_key)
+            if cached is not None:
+                keep = self._respond(
+                    writer, 200, cached, ctype="image/png",
+                    extra=(
+                        ("X-Tier-Served", tier), ("X-Cache", "hit"),
+                    ) + rid,
+                )
+                await writer.drain()
+                if t_req0 is not None:
+                    trace.record_span(
+                        "response_cache", "serving", t_req0,
+                        time.perf_counter(),
+                        args={"request_id": req_id, "tier": tier,
+                              "result": "hit", "bytes": len(cached)},
+                    )
+                return keep
+
         # Admission control: the deterministic fault hook, then the
         # queue-depth watermark — both shed with 429 + Retry-After.
         if faults.admit_should_reject():
@@ -876,11 +940,18 @@ class ServingServer:
                 )
             t_enc0 = time.perf_counter() if trace.enabled() else None
             png = await loop.run_in_executor(None, _encode_response_png, out)
+            served = getattr(fut, "tier", tier)
+            cache_extra = ()
+            if cache_key is not None:
+                # Brown-out policy: a downgraded answer (served != the
+                # requested tier) must never be stored — a later
+                # non-opt-in request with the same bytes would hit it.
+                if served == tier:
+                    self.response_cache.put(cache_key, png)
+                cache_extra = (("X-Cache", "miss"),)
             keep = self._respond(
                 writer, 200, png, ctype="image/png",
-                extra=(
-                    ("X-Tier-Served", getattr(fut, "tier", tier)),
-                ) + rid,
+                extra=(("X-Tier-Served", served),) + cache_extra + rid,
             )
             # Flush before the in-flight decrement: the drain poll must
             # not declare the server empty while this response is still
@@ -891,8 +962,7 @@ class ServingServer:
                 trace.record_span(
                     "response_write", "serving", t_enc0,
                     time.perf_counter(),
-                    args={"request_id": req_id,
-                          "tier": getattr(fut, "tier", tier),
+                    args={"request_id": req_id, "tier": served,
                           "bytes": len(png)},
                 )
             return keep
@@ -935,7 +1005,12 @@ class ServingServer:
             )
             return
         try:
-            cfg = StreamConfig.from_headers(headers, self.stream_window)
+            cfg = StreamConfig.from_headers(
+                headers,
+                self.stream_window,
+                default_reuse=self.stream_reuse_threshold,
+                default_max_reuse_run=self.stream_max_reuse_run,
+            )
         except ValueError as err:
             jresp(400, {"error": str(err)})
             return
@@ -1019,6 +1094,11 @@ class ServingServer:
                 f"weights):\n{report}"
             )
         self.batcher.set_params(new)
+        if self.response_cache is not None:
+            # Invalidate AFTER the swap: answers computed under the old
+            # params must never serve again, and a put racing the swap
+            # carries the old generation in its key and is refused.
+            self.response_cache.invalidate()
 
     async def _reload(self, body, writer) -> bool:
         if not self.ready.is_set() or self.draining.is_set():
@@ -1224,6 +1304,34 @@ def parse_args(argv=None):
         "X-Stream-Window).",
     )
     parser.add_argument(
+        "--stream-reuse-threshold", type=float, default=None,
+        help="Enable temporal frame reuse for streams: a frame whose "
+        "decimated mean-abs delta against the last computed frame is "
+        "at or below this threshold (uint8 scale) is answered from the "
+        "cached enhanced frame as an R record, without compute. 0 "
+        "reuses only byte-exact static frames; unset (the default) "
+        "disables reuse. Sessions override per connection with "
+        "X-Stream-Reuse (docs/SERVING.md 'Temporal reuse & response "
+        "cache').",
+    )
+    parser.add_argument(
+        "--stream-max-reuse-run", type=int,
+        default=DEFAULT_MAX_REUSE_RUN,
+        help="Staleness cap on temporal reuse: after this many "
+        "consecutive reused frames the next frame is recomputed "
+        "regardless of the delta score, so a stuck detector can never "
+        "freeze a stream (sessions override with "
+        "X-Stream-Max-Reuse-Run).",
+    )
+    parser.add_argument(
+        "--response-cache", type=int, default=0, metavar="N",
+        help="Content-addressed /enhance response cache: keep up to N "
+        "rendered answers keyed on (payload digest, tier, bucket "
+        "ladder, params generation), invalidated on /admin/reload. "
+        "Hits replay the stored PNG without decode or compute and "
+        "stamp X-Cache: hit. 0 (the default) disables the cache.",
+    )
+    parser.add_argument(
         "--slo", type=str, default=None, metavar="SPEC",
         help="Arm the SLO engine with a comma-separated objective list, "
         'e.g. "p99_ms<=250,error_rate<=0.01,availability>=0.999". '
@@ -1304,6 +1412,9 @@ def main(argv=None) -> int:
         max_streams=args.max_streams,
         stream_window=args.stream_window,
         slo=args.slo,
+        stream_reuse_threshold=args.stream_reuse_threshold,
+        stream_max_reuse_run=args.stream_max_reuse_run,
+        response_cache=args.response_cache,
     )
     return server.run(install_signal_handlers=True)
 

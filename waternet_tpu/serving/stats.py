@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 from waternet_tpu.obs import window as obswin
 from waternet_tpu.obs.slo import SloEngine, WindowSample
+from waternet_tpu.serving.reuse import empty_cache_block
 
 #: Latency reservoir size: percentiles are computed over at most this many
 #: uniformly-sampled requests (algorithm R), so a long-lived server's
@@ -162,12 +163,21 @@ class ServingStats:
       POST ``/stream`` session layer — ``frames_dropped`` (window
       overflow, queue shed, disconnect cleanup), ``frames_out_of_budget``
       (freshness deadline ran out), ``downgrades`` (stream frames served
-      by the fast tier under brown-out), a frame end-to-end latency
-      reservoir (read -> record written), plus the LIVE
+      by the fast tier under brown-out), ``frames_reused`` (frames
+      answered from the session's cached enhanced frame by temporal
+      gating — never computed; docs/SERVING.md "Temporal reuse &
+      response cache"), a frame end-to-end latency reservoir (read ->
+      record written; computed frames only — reused frames resolve in
+      encode time and would skew the compute signal), plus the LIVE
       ``active_streams`` gauge and per-session p99 map read through the
       probe the owning
       :class:`~waternet_tpu.serving.streams.StreamManager` registers
       (0 / {} for stats objects nothing registered on);
+    * the **response cache** block (``cache``): hit/miss/evict counters
+      and live entry/generation gauges read through the probe the
+      owning :class:`~waternet_tpu.serving.reuse.ResponseCache`
+      registers (an all-zeros ``enabled: false`` block for servers with
+      no cache configured);
     * **sliding windows** (``latency_ms_window`` + the ``window`` block,
       docs/OBSERVABILITY.md "Windows & SLOs"): the same latency / queue
       / shed / error signals over the trailing 60 s / 300 s, so a
@@ -233,6 +243,7 @@ class ServingStats:
         self.stream_frames_delivered = 0  # guarded-by: self._lock
         self.stream_frames_dropped = 0  # guarded-by: self._lock
         self.stream_frames_out_of_budget = 0  # guarded-by: self._lock
+        self.stream_frames_reused = 0  # guarded-by: self._lock
         self.stream_downgrades = 0  # guarded-by: self._lock
         # bounded reservoir sample (algorithm R)
         self._stream_lat_s: List[float] = []  # guarded-by: self._lock
@@ -242,6 +253,11 @@ class ServingStats:
         #: "per_session_p99_ms": {stream_id: p99}}. Left None, the summary
         #: reports 0 / {} — most stats objects have no stream layer.
         self.stream_probe = None
+        #: Live response-cache gauge: a zero-arg callable the owning
+        #: ResponseCache registers (ResponseCache.counters). Left None,
+        #: the summary reports the all-zeros enabled:false block — most
+        #: servers run without a cache.
+        self.cache_probe = None
 
     def declare_tier(self, tier: str) -> None:
         """Register a serving tier up front (a ReplicaPool does this at
@@ -410,13 +426,21 @@ class ServingStats:
         """One stream frame deliberately not delivered. ``reason``
         ``"budget"`` (freshness deadline ran out) counts as
         out-of-budget; any other reason (``"window"`` overflow,
-        ``"queue"`` shed, ``"disconnect"`` cleanup, ``"cancelled"``)
+        ``"queue"`` shed, ``"disconnect"`` cleanup, ``"cancelled"``,
+        ``"anchor"`` — a reuse child whose anchor never delivered)
         counts as a drop."""
         with self._lock:
             if reason == "budget":
                 self.stream_frames_out_of_budget += 1
             else:
                 self.stream_frames_dropped += 1
+
+    def record_stream_frame_reused(self) -> None:
+        """One stream frame answered from the session's cached enhanced
+        frame by temporal gating (reuse.py) — delivered to the client
+        as an ``R`` record without ever entering the batcher."""
+        with self._lock:
+            self.stream_frames_reused += 1
 
     def record_stream_downgrade(self) -> None:
         """One stream frame served by the fast tier under brown-out
@@ -559,11 +583,13 @@ class ServingStats:
             recovery_max = self._recovery_max_s
             tiers = {name: dict(c) for name, c in self._tiers.items()}
             stream_probe = self.stream_probe
+            cache_probe = self.cache_probe
             streams = {
                 "opened": self.streams_opened,
                 "refused": self.streams_refused,
                 "frames_in": self.stream_frames_in,
                 "frames_delivered": self.stream_frames_delivered,
+                "frames_reused": self.stream_frames_reused,
                 "frames_dropped": self.stream_frames_dropped,
                 "frames_out_of_budget": self.stream_frames_out_of_budget,
                 "downgrades": self.stream_downgrades,
@@ -604,6 +630,10 @@ class ServingStats:
             "load_imbalance": round(self.load_imbalance(), 3),
             "tiers": tiers,
             "streams": streams,
+            "cache": (
+                cache_probe() if cache_probe is not None
+                else empty_cache_block()
+            ),
             "per_replica": self.per_replica(),
             "window": self.window.block(),
             "slo": self.slo_state(),

@@ -43,6 +43,17 @@ Per-stream QoS policies, each deterministically fault-testable via
   CAN tier per frame (``FLAG_DOWNGRADED`` on the record); (2) frame
   dropping holds latency; (3) new sessions are refused with 503 +
   Retry-After while established streams keep their QoS.
+* **Temporal reuse** (off by default; ``X-Stream-Reuse`` or the
+  server's ``--stream-reuse-threshold`` enables it): a frame whose
+  decimated delta against the last frame *submitted for compute* (the
+  anchor — submission-time anchoring is what lets reuse fire under
+  backlog) is at or below the threshold is answered from the anchor's
+  enhanced frame WITHOUT entering the batcher — an ``R`` record
+  carrying ``FLAG_REUSED`` (byte-identical to a recompute for a delta
+  of zero), bounded by the ``max_reuse_run`` staleness cap. If the
+  anchor itself never delivered (dropped/errored), its reuse children
+  become ``anchor`` drops rather than replaying the wrong scene
+  (waternet_tpu/serving/reuse.py).
 
 Wire protocol (all integers network byte order):
 
@@ -50,9 +61,12 @@ Wire protocol (all integers network byte order):
   JPEG/PNG; length 0 ends the stream cleanly.
 * download: per record a 10-byte header ``!cBII`` = (kind, flags,
   seq, payload_len) then the payload. Kinds: ``F`` enhanced PNG frame;
-  ``D`` drop notice (JSON ``{"reason": ...}``); ``E`` frame error
-  (JSON); ``Z`` end-of-stream session summary (JSON). Flag bit 0
-  (``FLAG_DOWNGRADED``) marks a frame served by the fast tier.
+  ``R`` reused PNG frame (temporal gating answered it from the
+  session's cached enhanced frame); ``D`` drop notice (JSON
+  ``{"reason": ...}``); ``E`` frame error (JSON); ``Z`` end-of-stream
+  session summary (JSON). Flag bit 0 (``FLAG_DOWNGRADED``) marks a
+  frame served by the fast tier; bit 1 (``FLAG_REUSED``) marks a
+  reused frame.
 """
 
 from __future__ import annotations
@@ -72,6 +86,7 @@ from waternet_tpu.serving.batcher import (
     QueueFull,
     RequestCancelled,
 )
+from waternet_tpu.serving.reuse import DEFAULT_MAX_REUSE_RUN, FrameDeltaGate
 from waternet_tpu.serving.stats import LATENCY_RESERVOIR, _percentile
 
 #: Upload framing: one 4-byte big-endian payload length per frame.
@@ -80,6 +95,7 @@ FRAME_LEN = struct.Struct("!I")
 REC_HEAD = struct.Struct("!cBII")
 
 KIND_FRAME = b"F"
+KIND_REUSED = b"R"
 KIND_DROP = b"D"
 KIND_ERROR = b"E"
 KIND_END = b"Z"
@@ -87,6 +103,10 @@ KIND_END = b"Z"
 #: Record flag bit: this frame was served by the fast tier under
 #: brown-out (the stream opted in via X-Tier-Allow-Downgrade).
 FLAG_DOWNGRADED = 1
+#: Record flag bit: this frame was answered from the session's cached
+#: enhanced frame by temporal gating (reuse.py) — never computed. A
+#: reused copy of a downgraded frame carries both bits.
+FLAG_REUSED = 2
 
 #: One frame above this is a protocol error (the per-request front door
 #: caps bodies the same way): refuse loudly instead of buffering it.
@@ -107,18 +127,36 @@ class StreamConfig:
     ``X-Tier`` / ``X-Tier-Allow-Downgrade`` mean exactly what they mean
     on ``/enhance``; ``X-Stream-Window`` bounds the frames awaiting
     delivery before drop-oldest fires (default: the server's
-    ``--stream-window``). Raises ValueError on malformed values — the
-    front door answers 400."""
+    ``--stream-window``). ``X-Stream-Reuse`` sets the temporal-gating
+    delta threshold for this session (``off`` disables it even when the
+    server default enables it; absent inherits the server's
+    ``--stream-reuse-threshold``, itself off by default);
+    ``X-Stream-Max-Reuse-Run`` caps consecutive reuses and
+    ``X-Stream-Reuse-Warp`` enables the coarse block-flow pan
+    compensation. Raises ValueError on malformed values — the front
+    door answers 400."""
 
-    def __init__(self, fps, budget_ms, tier, allow_downgrade, window):
+    def __init__(self, fps, budget_ms, tier, allow_downgrade, window,
+                 reuse_threshold=None,
+                 max_reuse_run=DEFAULT_MAX_REUSE_RUN,
+                 reuse_warp=False):
         self.fps = fps
         self.budget_ms = budget_ms
         self.tier = tier
         self.allow_downgrade = allow_downgrade
         self.window = window
+        self.reuse_threshold = reuse_threshold
+        self.max_reuse_run = max_reuse_run
+        self.reuse_warp = reuse_warp
 
     @classmethod
-    def from_headers(cls, headers: dict, default_window: int):
+    def from_headers(
+        cls,
+        headers: dict,
+        default_window: int,
+        default_reuse: Optional[float] = None,
+        default_max_reuse_run: int = DEFAULT_MAX_REUSE_RUN,
+    ):
         fps = float(headers.get("x-stream-fps", "10"))
         if not fps > 0:
             raise ValueError(f"X-Stream-Fps must be > 0, got {fps}")
@@ -136,16 +174,45 @@ class StreamConfig:
         allow_downgrade = headers.get(
             "x-tier-allow-downgrade", ""
         ).strip().lower() in ("1", "true", "yes")
-        return cls(fps, budget_ms, tier, allow_downgrade, window)
+        raw_reuse = headers.get("x-stream-reuse")
+        if raw_reuse is None:
+            reuse = default_reuse
+        elif raw_reuse.strip().lower() in ("off", "none", ""):
+            reuse = None
+        else:
+            reuse = float(raw_reuse)  # ValueError -> 400, like the rest
+            if reuse < 0:
+                raise ValueError(
+                    f"X-Stream-Reuse must be >= 0 or 'off', got {reuse}"
+                )
+        max_run = int(
+            headers.get(
+                "x-stream-max-reuse-run", str(default_max_reuse_run)
+            )
+        )
+        if max_run < 1:
+            raise ValueError(
+                f"X-Stream-Max-Reuse-Run must be >= 1, got {max_run}"
+            )
+        reuse_warp = headers.get(
+            "x-stream-reuse-warp", ""
+        ).strip().lower() in ("1", "true", "yes")
+        return cls(
+            fps, budget_ms, tier, allow_downgrade, window,
+            reuse_threshold=reuse, max_reuse_run=max_run,
+            reuse_warp=reuse_warp,
+        )
 
 
 class _Frame:
     """One in-flight frame of one session, from socket read to record
     written. Exactly one terminal state: delivered (``future`` result),
-    dropped (``dropped`` holds the reason), or errored (``error``)."""
+    reused (``reused`` holds the cached enhanced frame), dropped
+    (``dropped`` holds the reason), or errored (``error``)."""
 
     __slots__ = (
         "seq", "t_read", "future", "dropped", "error", "delivering",
+        "reused",
     )
 
     def __init__(self, seq: int, t_read: float):
@@ -157,6 +224,10 @@ class _Frame:
         # The writer marks the head frame while awaiting/encoding it;
         # drop-oldest must never evict a frame mid-delivery.
         self.delivering = False
+        # Temporal gating (reuse.py): the gate's reuse decision tuple
+        # when the reader gated this frame out of compute; the writer
+        # materializes the cached enhanced frame from it at delivery.
+        self.reused = None
 
 
 class StreamSession:
@@ -184,11 +255,24 @@ class StreamSession:
         # Session accounting (the Z record and the /stats probe).
         self.frames_in = 0
         self.delivered = 0
+        self.reused = 0
         self.dropped = 0
         self.out_of_budget = 0
         self.errors = 0
         self.downgraded = 0
         self.lat_s: List[float] = []  # delivered-frame latency sample
+        # Temporal gating (off unless the session/server enabled it):
+        # reader task checks, writer task anchors — same event loop,
+        # so the gate needs no lock.
+        self.gate = (
+            FrameDeltaGate(
+                cfg.reuse_threshold,
+                max_reuse_run=cfg.max_reuse_run,
+                warp=cfg.reuse_warp,
+            )
+            if cfg.reuse_threshold is not None
+            else None
+        )
 
     # -- reader --------------------------------------------------------
 
@@ -249,6 +333,14 @@ class StreamSession:
                     # record in sequence position, and the stream lives.
                     entry.error = "frame is not a decodable image"
                 else:
+                    if self.gate is not None:
+                        # Temporal gating: a frame the gate recognises
+                        # is answered from the anchor's enhanced frame
+                        # at delivery and never enters the batcher
+                        # (reuse.py — the anchor is the last SUBMITTED
+                        # frame, so reuse works even under backlog).
+                        entry.reused = self.gate.check(rgb)
+                if rgb is not None and entry.reused is None:
                     deadline = entry.t_read + self.cfg.budget_ms / 1e3
                     try:
                         entry.future = self.mgr.batcher.submit(
@@ -258,6 +350,8 @@ class StreamSession:
                             allow_downgrade=self.cfg.allow_downgrade,
                             request_id=f"{self.req_id}/{entry.seq}",
                         )
+                        if self.gate is not None:
+                            self.gate.note_submitted(rgb, entry.seq)
                     except QueueFull:
                         entry.dropped = "queue"
                     except DeadlineExpired:
@@ -287,9 +381,12 @@ class StreamSession:
         oldest pending frame (never the one the writer is mid-delivery
         on) becomes an explicit ``window`` drop; its future is marked
         abandoned so the batcher drops the compute too."""
+        # Reused entries are already answered (no compute pending), so
+        # they never count against the window and are never evicted —
+        # drop-oldest exists to shed queued COMPUTE, not finished work.
         live = [
             e for e in self.entries
-            if e.dropped is None and e.error is None
+            if e.dropped is None and e.error is None and e.reused is None
         ]
         while len(live) > self.cfg.window:
             victim = next(
@@ -335,6 +432,44 @@ class StreamSession:
 
     async def _deliver(self, entry: _Frame) -> None:
         loop = asyncio.get_running_loop()
+        if entry.reused is not None:
+            hit = self.gate.materialize(entry.reused)
+            if hit is not None:
+                # Temporal reuse: answer from the anchor's enhanced
+                # frame — encode and write the R record (byte-identical
+                # to a recompute for a delta of zero; the PNG encoder is
+                # deterministic on the identical array). The downgrade
+                # bit, if any, is inherited from the anchor frame.
+                out, anchor_flags = hit
+                flags = FLAG_REUSED | anchor_flags
+                png = await loop.run_in_executor(
+                    None, self.mgr.encode, out
+                )
+                await self._write_record(
+                    KIND_REUSED, flags, entry.seq, png
+                )
+                self.reused += 1
+                self.mgr.stats.record_stream_frame_reused()
+                if trace.enabled():
+                    # A distinct span name keeps reused frames out of
+                    # the device stage in waternet-trace's per-stage
+                    # table — they never touched a replica.
+                    trace.record_span(
+                        "frame_reuse", "serving", entry.t_read,
+                        time.perf_counter(),
+                        args={
+                            "request_id": f"{self.req_id}/{entry.seq}",
+                            "stream": self.sid,
+                            "seq": entry.seq,
+                            "downgraded": bool(flags & FLAG_DOWNGRADED),
+                        },
+                    )
+                return
+            # The decision's anchor never delivered (dropped or
+            # errored before its turn): the cached output belongs to
+            # an older scene, so replaying it would show the wrong
+            # content. An honest drop instead.
+            entry.dropped = "anchor"
         if entry.dropped is None and entry.error is None:
             try:
                 out = await asyncio.wrap_future(entry.future)
@@ -374,6 +509,12 @@ class StreamSession:
             flags |= FLAG_DOWNGRADED
             self.downgraded += 1
             self.mgr.stats.record_stream_downgrade()
+        if self.gate is not None:
+            # Record the delivered output so this frame's reuse
+            # children (gated while it was still in flight) can
+            # materialize it — inheriting the downgrade bit, so a
+            # browned-out anchor never masquerades as quality.
+            self.gate.note_computed(entry.seq, out, flags)
         png = await loop.run_in_executor(None, self.mgr.encode, out)
         await self._write_record(KIND_FRAME, flags, entry.seq, png)
         span = time.perf_counter() - entry.t_read
@@ -410,6 +551,7 @@ class StreamSession:
             "stream_id": self.sid,
             "frames_in": self.frames_in,
             "delivered": self.delivered,
+            "reused": self.reused,
             "dropped": self.dropped,
             "out_of_budget": self.out_of_budget,
             "errors": self.errors,
