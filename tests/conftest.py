@@ -121,6 +121,33 @@ def locktrace():
     tracer.assert_acyclic()
 
 
+@pytest.fixture
+def looptrace(request):
+    """Dynamic event-loop-lag watchdog (docs/LINT.md 'Asyncio rules'):
+    every loop callback that runs during the test is timed through a
+    ``Handle._run`` wrap; teardown fails the test if any single callback
+    held the loop past the threshold, naming the callback — the runtime
+    companion of jaxlint R201, catching blocking work reached through C
+    extensions or data-dependent slow paths the may-block fixpoint
+    cannot see. Opt in per module with ``pytestmark =
+    pytest.mark.usefixtures("looptrace")``; a test that wedges the loop
+    on purpose opts out with ``@pytest.mark.loop_stall_ok``. The
+    threshold is deliberately generous (wall time on a loaded 1-core CI
+    box charges preemption to whoever was running); override with
+    ``LOOPTRACE_THRESHOLD_MS``."""
+    from waternet_tpu.analysis.looptrace import LoopTracer
+
+    threshold = float(os.environ.get("LOOPTRACE_THRESHOLD_MS", "500"))
+    tracer = LoopTracer(threshold_ms=threshold)
+    tracer.install()
+    try:
+        yield tracer
+    finally:
+        tracer.uninstall()
+    if request.node.get_closest_marker("loop_stall_ok") is None:
+        tracer.assert_no_stall()
+
+
 class CompileSentinel:
     """Dynamic companion of jaxlint (docs/LINT.md): snapshot the per-jit
     executable-cache sizes of armed step functions and fail if any of

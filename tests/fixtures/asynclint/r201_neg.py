@@ -1,0 +1,34 @@
+"""R201 negative: the sanctioned ways to do heavy or waity work from a
+coroutine — executor wraps, awaits, scheduling wrappers, and the
+non-blocking call shapes the taxonomy deliberately exempts.
+"""
+
+import asyncio
+
+
+def render_overlay(frame):  # loop-blocking: full-frame pixel pass
+    return [px * 2 for px in frame]
+
+
+async def deliver(frame):
+    loop = asyncio.get_running_loop()
+    # exempt: the blocking helper runs on an executor thread
+    return await loop.run_in_executor(None, render_overlay, frame)
+
+
+async def reap(ev, parts, cache, lock):
+    # exempt: scheduling wrapper takes the awaitable, nothing blocks here
+    waiter = asyncio.ensure_future(ev.wait())
+    # exempt: .get() with a positional arg is a dict read, not a queue
+    entry = cache.get("anchor")
+    # exempt: non-blocking acquire polls instead of parking the loop
+    held = lock.acquire(False)
+    if held:
+        lock.release()
+    # exempt: .join() with an argument is str.join
+    label = ", ".join(parts)
+    done = await waiter
+    # exempt: .result() on a retained task is a post-await read
+    task = asyncio.ensure_future(ev.wait())
+    await task
+    return entry, label, done, task.result()

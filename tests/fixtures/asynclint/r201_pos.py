@@ -1,0 +1,24 @@
+"""R201 positive: blocking calls reached on the event loop.
+
+Each one parks the loop thread — every open connection, timer, and
+heartbeat on that loop freezes for the duration.
+"""
+
+import time
+
+
+def render_overlay(frame):  # loop-blocking: full-frame pixel pass
+    return [px * 2 for px in frame]
+
+
+async def poll_queue(q):
+    item = q.get()  # BAD: queue read blocks the loop thread
+    return item
+
+
+async def nap_between_frames():
+    time.sleep(0.2)  # BAD: parks the whole loop, not just this task
+
+
+async def deliver(frame):
+    return render_overlay(frame)  # BAD: declared loop-blocking helper

@@ -1,0 +1,19 @@
+"""R202 negative: retained, awaited, and reaped tasks."""
+
+import asyncio
+
+
+async def flush_metrics():
+    await asyncio.sleep(0)
+
+
+async def on_request(tasks):
+    task = asyncio.ensure_future(flush_metrics())  # exempt: handle stored
+    tasks.append(task)
+    await flush_metrics()  # exempt: awaited directly
+    return task
+
+
+async def on_shutdown(tasks):
+    # exempt: gathered — the wrapper retains and awaits every handle
+    await asyncio.gather(*tasks)

@@ -1,0 +1,21 @@
+"""R202 positive: fire-and-forget tasks and dropped coroutine objects.
+
+The loop keeps only a weak reference to a task: if nothing retains the
+handle, GC can cancel it mid-flight. A bare coroutine call never even
+starts — it builds the coroutine object and drops it.
+"""
+
+import asyncio
+
+
+async def flush_metrics():
+    await asyncio.sleep(0)
+
+
+async def on_request():
+    asyncio.ensure_future(flush_metrics())  # BAD: handle dropped, GC may cancel
+    return "ok"
+
+
+async def on_disconnect():
+    flush_metrics()  # BAD: bare coroutine call — never scheduled at all

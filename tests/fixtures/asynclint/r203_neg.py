@@ -1,0 +1,19 @@
+"""R203 negative: the thread-safe marshalling idiom, and loop calls
+made from the loop itself."""
+
+import threading
+
+
+class CompletionBridge:
+    def __init__(self, loop):
+        self._loop = loop
+        self._fut = loop.create_future()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self):
+        # exempt: call_soon_threadsafe is the sanctioned cross-thread door
+        self._loop.call_soon_threadsafe(self._fut.set_result, "done")
+
+    async def arm(self):
+        # exempt: coroutines run ON the loop; direct loop calls are fine
+        self._loop.call_soon(print, "armed")

@@ -1,0 +1,20 @@
+"""R203 positive: worker threads touching the loop without
+call_soon_threadsafe.
+
+Loop methods and loop-future completion are not thread-safe: from any
+other thread they race the loop's internals and can corrupt or simply
+never wake it.
+"""
+
+import threading
+
+
+class CompletionBridge:
+    def __init__(self, loop):
+        self._loop = loop
+        self._fut = loop.create_future()
+        self._worker = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self):
+        self._loop.call_soon(print, "done")  # BAD: loop call off-thread
+        self._fut.set_result("done")  # BAD: loop future completed off-thread

@@ -1,0 +1,24 @@
+"""R204 negative: asyncio locks across awaits (their whole point), and
+threading locks released before suspending."""
+
+import asyncio
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+        self._tlock = threading.Lock()
+        self.value = 0
+
+    async def bump(self):
+        # exempt: asyncio.Lock is built to be held across awaits
+        async with self._alock:
+            await asyncio.sleep(0)
+            self.value += 1
+
+    async def snapshot(self):
+        with self._tlock:
+            out = self.value  # threading lock held, but no await inside
+        await asyncio.sleep(0)  # exempt: lock already released
+        return out

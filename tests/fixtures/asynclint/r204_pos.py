@@ -1,0 +1,21 @@
+"""R204 positive: awaits while holding a threading lock.
+
+The suspension point keeps the lock held until the loop gets back to
+this task — unbounded from the lock's point of view — so every thread
+contending for it stalls behind a scheduler decision.
+"""
+
+import asyncio
+import threading
+
+
+class SharedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    async def bump_slowly(self):
+        with self._lock:
+            await asyncio.sleep(0)  # BAD: suspends holding a threading lock
+            self.value += 1
+            await asyncio.sleep(0)  # BAD: and again on the way out

@@ -1,0 +1,31 @@
+"""R205 negative: cancellation-correct handlers — re-raise, narrow
+except, the cancel-and-reap idiom, and sync code (where BaseException
+has no cancellation to eat)."""
+
+import asyncio
+
+
+async def pump(reader, writer):
+    try:
+        while True:
+            writer.write(await reader.read())
+    except asyncio.CancelledError:
+        writer.close()
+        raise  # exempt: cleanup then re-raise keeps cancellation flowing
+    except Exception:  # exempt: Exception does not catch CancelledError
+        return None
+
+
+async def stop_child(child):
+    child.cancel()
+    try:
+        await child
+    except asyncio.CancelledError:  # exempt: cancel-and-reap of own child
+        pass
+
+
+def sync_guard(fn):
+    try:
+        return fn()
+    except BaseException:  # exempt: not a coroutine — no cancellation here
+        return None

@@ -1,0 +1,22 @@
+"""R205 positive: coroutines eating cancellation.
+
+Cancellation is how disconnect cleanup and drain propagate; an except
+that swallows it leaves the task running after everyone thinks it died.
+"""
+
+import asyncio
+
+
+async def pump(reader, writer):
+    try:
+        while True:
+            writer.write(await reader.read())
+    except asyncio.CancelledError:  # BAD: cancel vanishes, pump keeps going
+        pass
+
+
+async def supervise(task):
+    try:
+        await task
+    except BaseException:  # BAD: catches CancelledError and drops it
+        return None

@@ -1,0 +1,18 @@
+"""Suppression contract for the asyncio family: two real findings, both
+justified in place — same-line and disable-next forms."""
+
+import time
+
+
+async def warmup_probe():
+    # The one-shot warmup deliberately rides the loop: nothing else is
+    # scheduled yet, and moving it to an executor would reorder startup.
+    time.sleep(0.01)  # jaxlint: disable=R201 startup warmup: loop is otherwise idle
+
+
+async def drain(task):
+    try:
+        await task
+    # jaxlint: disable-next=R205 drain barrier: cancellation is the success path here
+    except BaseException:
+        return None

@@ -1,0 +1,417 @@
+"""asynclint: the event-loop rule family (R201–R205), its fixture
+corpus, the repo-wide zero-findings gate, the merged waternet-lint
+runner, the looptrace runtime watchdog, and the regression pins for the
+real loop-blocking work the sweep surfaced.
+
+``test_repo_clean`` is the tier-1 gate the tentpole exists for: the
+production tree (package + CLIs + tools) must carry zero unsuppressed
+R20x findings, so every new blocking-call/fire-and-forget/cross-thread/
+await-under-lock/swallowed-cancel hazard either gets fixed or argued
+for in a suppression comment reviewers can see.
+"""
+
+import ast
+import asyncio
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from waternet_tpu.analysis import (
+    RULES,
+    build_lock_graph,
+    collect_py_files,
+    lint_file,
+    lint_models,
+    lint_paths,
+    lint_source,
+    parse_model,
+)
+from waternet_tpu.analysis.cli import main as jaxlint_main
+from waternet_tpu.analysis.core import ModuleModel
+from waternet_tpu.analysis.lint_all import main as lint_all_main
+from waternet_tpu.analysis.looptrace import (
+    LoopTracer,
+    describe_callback,
+    empty_loop_lag_block,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "asynclint"
+#: The acceptance-criteria lint surface: the package, every CLI, and the
+#: tools tree (one file set => one project for the may-block fixpoint).
+LINT_TARGETS = (
+    "waternet_tpu", "train.py", "score.py", "inference.py", "bench.py",
+    "tools",
+)
+R_RULES = ("R201", "R202", "R203", "R204", "R205")
+
+
+def _model(path, source) -> ModuleModel:
+    return ModuleModel(str(path), source, ast.parse(source))
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide gate (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_clean():
+    findings, files = lint_paths(
+        [REPO / t for t in LINT_TARGETS], rules=R_RULES
+    )
+    unsuppressed = [f for f in findings if not f.suppressed]
+    assert files >= 60, f"lint surface shrank unexpectedly: {files} files"
+    assert not unsuppressed, (
+        "unsuppressed asynclint findings:\n"
+        + "\n".join(f.render() for f in unsuppressed)
+    )
+
+
+def test_repo_carries_justified_loop_wedge_suppression():
+    # The gateway_hang fault handler blocks the LOOP thread on purpose
+    # (wedging /healthz and the beat task together is the failure being
+    # injected); the R201 suppression argues that in place.
+    findings, _ = lint_paths([REPO / t for t in LINT_TARGETS], rules=R_RULES)
+    sup = [f for f in findings if f.suppressed and f.rule == "R201"]
+    assert any("server.py" in f.path for f in sup)
+
+
+def test_repo_lock_graph_still_acyclic_with_r204_edges_folded_in():
+    """R204's hazard edges are part of the SAME static lock graph by
+    construction: ``call_events`` walks every call — including calls
+    inside ``await`` expressions — with the lexically held locks, so a
+    lock acquired by an awaited helper while a threading lock is held
+    shows up as an ordered edge. Re-pin the repo graph acyclic and
+    non-empty with the asyncio modules in the scan set."""
+    models = [
+        parse_model(f)
+        for f in collect_py_files([REPO / t for t in LINT_TARGETS])
+    ]
+    graph = build_lock_graph(models)
+    assert graph.cycles() == []
+    dot = graph.to_dot()
+    assert dot.startswith("digraph lock_order")
+    assert "->" in dot, "expected at least one lock-order edge in the repo"
+
+
+def test_await_reached_lock_contributes_a_graph_edge():
+    """The synthetic proof of the folding claim above: a coroutine that
+    awaits a helper while holding lock A, where the helper's sync path
+    acquires lock B, contributes A -> B — and the same await trips
+    R204."""
+    src = (
+        "import threading\n"
+        "LOCK_A = threading.Lock()\n"
+        "LOCK_B = threading.Lock()\n"
+        "def helper():\n"
+        "    with LOCK_B:\n"
+        "        return 1\n"
+        "async def outer(x):\n"
+        "    with LOCK_A:\n"
+        "        await x.put(helper())\n"
+    )
+    graph = build_lock_graph([_model("folded.py", src)])
+    edges = {
+        (a.display, b.display)
+        for a, targets in graph.edges.items()
+        for b in targets
+    }
+    assert ("folded.LOCK_A", "folded.LOCK_B") in edges
+    r204 = [f for f in lint_source(src, "folded.py") if f.rule == "R204"]
+    assert len(r204) == 1
+
+
+def test_registry_has_all_five_rules():
+    assert set(R_RULES) <= set(RULES)
+    for rid in R_RULES:
+        assert RULES[rid].name and RULES[rid].description
+
+
+# ---------------------------------------------------------------------------
+# Fixture corpus: each rule fires on its positive, stays quiet on its
+# negative, and fires ONLY its own rule on the positive.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", R_RULES)
+def test_rule_fires_on_positive_fixture(rule):
+    findings = lint_file(FIXTURES / f"{rule.lower()}_pos.py")
+    fired = {f.rule for f in findings if not f.suppressed}
+    assert fired == {rule}, (
+        f"expected exactly {{{rule}}} on the positive fixture, got {fired}"
+    )
+    assert len([f for f in findings if f.rule == rule]) >= 2
+
+
+@pytest.mark.parametrize("rule", R_RULES)
+def test_rule_quiet_on_negative_fixture(rule):
+    findings = lint_file(FIXTURES / f"{rule.lower()}_neg.py")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_suppression_comments_silence_but_are_counted():
+    findings = lint_file(FIXTURES / "suppressed.py")
+    assert len(findings) == 2  # same-line and disable-next forms
+    assert all(f.suppressed for f in findings)
+    assert {f.rule for f in findings} == {"R201", "R205"}
+
+
+def test_rule_filter_restricts_output():
+    findings = lint_file(FIXTURES / "r201_pos.py", rules=["R204"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# Regression pins for the real finding the sweep surfaced: the reuse
+# materialize (full-frame warp) used to run ON the stream loop.
+# Reverting the executor wrap must light up R201 at the exact site.
+# ---------------------------------------------------------------------------
+
+_FIX_MARKER = (
+    "            hit = await loop.run_in_executor(\n"
+    "                None, self.gate.materialize, entry.reused\n"
+    "            )"
+)
+
+
+def _lint_streams_project(streams_src):
+    """Lint streams.py together with reuse.py (the may-block chain
+    materialize -> shift_frame crosses that module boundary)."""
+    models = [
+        _model(REPO / "waternet_tpu/serving/streams.py", streams_src),
+        parse_model(REPO / "waternet_tpu/serving/reuse.py"),
+    ]
+    return lint_models(models, rules=["R201"])
+
+
+def test_r201_fires_when_materialize_executor_wrap_reverted():
+    src = (REPO / "waternet_tpu" / "serving" / "streams.py").read_text()
+    assert _FIX_MARKER in src, "materialize executor wrap moved; update test"
+    reverted = src.replace(
+        _FIX_MARKER, "            hit = self.gate.materialize(entry.reused)"
+    )
+    fired = [
+        f for f in _lint_streams_project(reverted)
+        if f.rule == "R201" and not f.suppressed
+    ]
+    assert fired, "R201 must fire when materialize runs on the loop again"
+    assert any("materialize" in f.message for f in fired)
+    assert any("shift_frame" in f.message for f in fired)
+    clean = [
+        f for f in _lint_streams_project(src)
+        if f.rule == "R201" and not f.suppressed
+    ]
+    assert clean == [], "\n".join(f.render() for f in clean)
+
+
+def test_loop_blocking_annotation_is_load_bearing():
+    """shift_frame is pure numpy — nothing in the blocking taxonomy —
+    so the ``# loop-blocking:`` declaration is what lets the fixpoint
+    reach the warp path. Stripping it must go quiet even on the
+    reverted (on-loop) materialize call: if this ever starts firing
+    without the annotation, the taxonomy grew and the annotation can
+    come off."""
+    streams_src = (
+        (REPO / "waternet_tpu" / "serving" / "streams.py")
+        .read_text()
+        .replace(
+            _FIX_MARKER,
+            "            hit = self.gate.materialize(entry.reused)",
+        )
+    )
+    reuse_src = (REPO / "waternet_tpu" / "serving" / "reuse.py").read_text()
+    assert "# loop-blocking:" in reuse_src, "annotation moved; update test"
+    stripped = reuse_src.replace(
+        "  # loop-blocking: full-resolution numpy warp, milliseconds per frame",
+        "",
+    )
+    models = [
+        _model(REPO / "waternet_tpu/serving/streams.py", streams_src),
+        _model(REPO / "waternet_tpu/serving/reuse.py", stripped),
+    ]
+    findings = [f for f in lint_models(models, rules=["R201"]) if not f.suppressed]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_r201_fires_when_gateway_hang_suppression_removed():
+    src = (REPO / "waternet_tpu" / "serving" / "server.py").read_text()
+    marker = "  # jaxlint: disable=R201 fault injection: wedging the loop IS the test"
+    assert marker in src, "gateway_hang suppression moved; update test"
+    bare = src.replace(marker, "")
+    fired = [
+        f for f in lint_source(bare, "server.py")
+        if f.rule == "R201" and not f.suppressed
+    ]
+    assert any("_enhance" in f.message and ".wait()" in f.message for f in fired)
+
+
+# ---------------------------------------------------------------------------
+# looptrace: the dynamic companion (tests/conftest.py::looptrace)
+# ---------------------------------------------------------------------------
+
+
+def _spin_loop_with(callback):
+    async def main():
+        loop = asyncio.get_running_loop()
+        loop.call_soon(callback)
+        await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+
+
+def test_looptrace_detects_a_stall_and_names_the_callback():
+    tracer = LoopTracer(threshold_ms=50.0)
+    tracer.install()
+    try:
+        _spin_loop_with(_wedge)
+    finally:
+        tracer.uninstall()
+    assert tracer.max_ms >= 100.0
+    assert tracer.stalls, "a 120 ms callback must register as a stall"
+    with pytest.raises(AssertionError) as exc:
+        tracer.assert_no_stall()
+    msg = str(exc.value)
+    assert "_wedge" in msg, msg
+    assert "run_in_executor" in msg  # points at the remedy
+
+
+def _wedge():
+    time.sleep(0.12)
+
+
+def test_looptrace_quiet_loop_passes_and_gauges():
+    tracer = LoopTracer(threshold_ms=500.0)
+    tracer.install()
+    try:
+        _spin_loop_with(lambda: None)
+    finally:
+        tracer.uninstall()
+    tracer.assert_no_stall()
+    g = tracer.gauge()
+    assert set(g) == {"max_ms", "p99_ms", "callbacks", "stalls"}
+    assert g["callbacks"] > 0
+    assert g["stalls"] == 0
+    assert 0.0 <= g["p99_ms"] <= max(g["max_ms"], 0.001)
+
+
+def test_looptrace_uninstall_restores_handle_run():
+    import asyncio.events as events
+
+    before = events.Handle._run
+    tracer = LoopTracer()
+    tracer.install()
+    inner = LoopTracer()
+    inner.install()  # nested tracers chain and unwind LIFO
+    inner.uninstall()
+    tracer.uninstall()
+    assert events.Handle._run is before
+
+
+def test_describe_callback_unwraps_partials():
+    import functools
+
+    class FakeHandle:
+        _callback = functools.partial(functools.partial(_wedge, ), )
+
+    assert describe_callback(FakeHandle()).endswith("_wedge")
+
+
+def test_empty_loop_lag_block_matches_live_gauge_schema():
+    block = empty_loop_lag_block()
+    live = LoopTracer().gauge()
+    assert set(block) == {"enabled"} | set(live)
+    assert block["enabled"] is False
+
+
+@pytest.mark.loop_stall_ok
+def test_fixture_opt_out_records_but_does_not_fail(looptrace):
+    """The loop_stall_ok contract: a test that wedges the loop on
+    purpose still gets its lag recorded, but teardown must not fail."""
+    _spin_loop_with(lambda: time.sleep(0.6))
+    assert looptrace.max_ms >= 500.0
+    assert looptrace.stalls  # teardown sees these and must stay quiet
+
+
+# ---------------------------------------------------------------------------
+# loop_lag gauge plumbing (--obs-loop-lag)
+# ---------------------------------------------------------------------------
+
+
+def test_loop_lag_probe_feeds_stats_and_metrics():
+    from waternet_tpu.obs.prometheus import render_prometheus
+    from waternet_tpu.serving.stats import ServingStats
+
+    stats = ServingStats()
+    tracer = LoopTracer(threshold_ms=float("inf"))
+    stats.loop_lag_probe = lambda: {"enabled": True, **tracer.gauge()}
+    tracer.install()
+    try:
+        _spin_loop_with(lambda: None)
+    finally:
+        tracer.uninstall()
+    block = stats.summary()["loop_lag"]
+    assert block["enabled"] is True
+    assert block["callbacks"] > 0
+    assert block["stalls"] == 0  # infinite threshold: gauges only
+    text = render_prometheus(stats.summary())
+    assert "waternet_loop_lag_max_ms" in text
+    assert "waternet_loop_lag_p99_ms" in text
+    assert "waternet_loop_lag_enabled 1" in text
+
+
+def test_obs_loop_lag_flag_default_off():
+    from waternet_tpu.serving.server import parse_args
+
+    assert parse_args([]).obs_loop_lag is False
+    assert parse_args(["--obs-loop-lag"]).obs_loop_lag is True
+
+
+# ---------------------------------------------------------------------------
+# CLI surface: jaxlint picks up the family; waternet-lint merges all
+# three families into one invocation with a single exit code.
+# ---------------------------------------------------------------------------
+
+
+def test_jaxlint_list_rules_includes_asyncio_family(capsys):
+    assert jaxlint_main(["--list-rules", "."]) == 0
+    out = capsys.readouterr().out
+    for rid in R_RULES:
+        assert rid in out
+
+
+def test_waternet_lint_fixture_scan_merges_and_exits_nonzero(capsys):
+    rc = lint_all_main([str(FIXTURES), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["files_scanned"] == 11
+    fams = payload["summary"]["families"]
+    assert set(fams) >= {"jaxlint", "threadlint", "asynclint"}
+    assert fams["asynclint"]["unsuppressed"] == 11
+    assert fams["asynclint"]["findings"] == 13  # + the 2 suppressed
+    assert fams["jaxlint"]["findings"] == 0
+    assert fams["threadlint"]["findings"] == 0
+    assert {f["rule"] for f in payload["findings"]} == set(R_RULES)
+
+
+def test_waternet_lint_default_surface_is_clean(monkeypatch, capsys):
+    monkeypatch.chdir(REPO)
+    rc = lint_all_main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "[jaxlint]" in out and "[threadlint]" in out and "[asynclint]" in out
+
+
+def test_waternet_lint_list_rules_groups_by_family(capsys):
+    assert lint_all_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert out.index("[jaxlint]") < out.index("[threadlint]") < out.index(
+        "[asynclint]"
+    )
+    for rid in ("R001", "R101", "R201"):
+        assert rid in out
+
+
+def test_waternet_lint_rejects_unknown_rule(capsys):
+    assert lint_all_main(["--rules", "R999", str(FIXTURES)]) == 2

@@ -44,7 +44,9 @@ from waternet_tpu.serving.fleet import (
     worker_id,
 )
 
-pytestmark = pytest.mark.usefixtures("locktrace")
+# locktrace: lock-order watchdog; looptrace: event-loop-lag watchdog on
+# the router loop (worker loops live in subprocesses, out of its reach).
+pytestmark = pytest.mark.usefixtures("locktrace", "looptrace")
 
 STUB = Path(__file__).resolve().parent / "fleet_worker.py"
 _FRAME_LEN = struct.Struct("!I")

@@ -54,8 +54,9 @@ sys.path.insert(0, str(REPO))
 from tools.distill_fixture import FIXTURE_DIR  # noqa: E402
 
 # Lock-order watchdog on the whole threaded suite (docs/LINT.md
-# "Concurrency rules", tests/conftest.py::locktrace).
-pytestmark = pytest.mark.usefixtures("locktrace")
+# "Concurrency rules", tests/conftest.py::locktrace) plus the
+# event-loop-lag watchdog (tests/conftest.py::looptrace).
+pytestmark = pytest.mark.usefixtures("locktrace", "looptrace")
 
 BUCKET = (32, 32)
 MAX_BATCH = 4

@@ -42,6 +42,12 @@ REPO = Path(__file__).resolve().parent.parent
 BUCKET = (32, 32)
 MAX_BATCH = 4
 
+# Event-loop-lag watchdog on the whole serving suite: any single
+# callback holding the server loop past the threshold fails the test
+# (docs/LINT.md "Asyncio rules", tests/conftest.py::looptrace). Tests
+# that wedge the loop on purpose (gateway_hang) mark loop_stall_ok.
+pytestmark = pytest.mark.usefixtures("looptrace")
+
 
 @pytest.fixture(scope="module")
 def params():

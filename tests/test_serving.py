@@ -406,7 +406,7 @@ def test_stats_schema_and_latency_percentiles():
         "recovery_sec_max", "replica_health", "queue_depth",
         "queue_depth_mean", "queue_depth_max", "replicas",
         "images_per_sec", "load_imbalance", "tiers", "streams",
-        "cache", "per_replica", "window", "slo",
+        "cache", "loop_lag", "per_replica", "window", "slo",
     }
     # Sliding-window restatement (docs/OBSERVABILITY.md "Windows &
     # SLOs"): just-recorded latencies are in the 60 s window, quantiles
@@ -433,6 +433,12 @@ def test_stats_schema_and_latency_percentiles():
     assert summary["cache"] == {
         "enabled": False, "hits": 0, "misses": 0, "evictions": 0,
         "entries": 0, "capacity": 0, "generation": 0,
+    }
+    # Loop-lag block (docs/LINT.md "Asyncio rules"): all-zeros disabled
+    # block unless the server armed --obs-loop-lag.
+    assert summary["loop_lag"] == {
+        "enabled": False, "max_ms": 0.0, "p99_ms": 0.0,
+        "callbacks": 0, "stalls": 0,
     }
     # Fault-isolation counters (docs/SERVING.md "Fault isolation").
     assert summary["retried"] == 2

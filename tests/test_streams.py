@@ -44,7 +44,10 @@ from tools.distill_fixture import FIXTURE_DIR  # noqa: E402
 # Lock-order watchdog on the whole threaded suite: every test runs with
 # instrumented locks; an observed lock-order cycle fails the test
 # (docs/LINT.md "Concurrency rules", tests/conftest.py::locktrace).
-pytestmark = pytest.mark.usefixtures("locktrace")
+# looptrace adds the event-loop-lag watchdog: a single callback holding
+# the session loop past the threshold fails the test (R201's runtime
+# companion, docs/LINT.md "Asyncio rules").
+pytestmark = pytest.mark.usefixtures("locktrace", "looptrace")
 
 #: Same single executable shape as the rest of the serving suite: after
 #: the first compile the persistent XLA cache makes every server warmup

@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""waternet-lint — every rule family in one pass (docs/LINT.md).
+
+Thin launcher for :mod:`waternet_tpu.analysis.lint_all` that works from
+a source checkout without installation (the ``waternet-lint`` console
+entry in pyproject.toml is the installed form). Typical invocations::
+
+    python tools/lint_all.py                 # repo lint surface, all families
+    python tools/lint_all.py --json          # machine rendering for CI
+    python tools/lint_all.py --list-rules    # catalogue grouped by family
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/parse error.
+"""
+
+import sys
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(_REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(_REPO_ROOT))
+
+from waternet_tpu.analysis.lint_all import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

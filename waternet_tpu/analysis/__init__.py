@@ -16,14 +16,21 @@ R102    lock-order-inversion             cycle in the whole-repo lock-acquisitio
 R103    blocking-call-under-lock         result()/join()/get()/sleep/host-sync under a lock
 R104    condition-wait-without-predicate Condition.wait() not re-checked in a while loop
 R105    unjoined-thread                  non-daemon Thread started with no join/leak guard
+R201    blocking-call-in-coroutine       blocking work reachable from a coroutine, no executor
+R202    fire-and-forget-task             unretained create_task / bare unawaited coroutine call
+R203    cross-thread-loop-access         non-threadsafe loop/future calls from off-loop code
+R204    await-under-threading-lock       await while lexically holding a threading.* lock
+R205    swallowed-cancellation           CancelledError caught in a coroutine, not re-raised
 ======  ===============================  ==================================================
 
 Suppress a deliberate pattern with ``# jaxlint: disable=R00x <why>`` on
 the line (or ``disable-next=`` on the line above); the justification text
 is free-form and strongly encouraged. ``tests/test_jaxlint.py::
-test_repo_clean`` and ``tests/test_threadlint.py::test_repo_clean``
-assert zero unsuppressed findings over the package and the CLIs, so
-every new hazard is either fixed or visibly argued for.
+test_repo_clean``, ``tests/test_threadlint.py::test_repo_clean``, and
+``tests/test_asynclint.py::test_repo_clean`` assert zero unsuppressed
+findings over the package, the CLIs, and ``tools/``, so every new
+hazard is either fixed or visibly argued for. ``waternet-lint``
+(``lint_all.py``) runs all three families in one invocation.
 
 R102 is project-scope: it builds one static lock-acquisition graph over
 every scanned module (nested ``with``/``acquire`` sites plus calls made

@@ -1,0 +1,205 @@
+"""waternet-lint — all three rule families in one pass (docs/LINT.md).
+
+CI and the verify recipe used to invoke jaxlint three times (JAX rules,
+thread rules, asyncio rules are one registry, but each caller passed its
+own path set and merged exit codes by hand). This runner is the single
+entry point: one scan over the repo's lint targets, one merged report
+with a per-family breakdown, one exit code.
+
+Families are rule-id bands on the shared registry:
+
+======  ==========  ==================================================
+R0xx    jaxlint     JAX hazards (donation, RNG, host sync, recompile,
+                    tracer leaks)
+R1xx    threadlint  thread hazards (guarded-by, lock order, blocking
+                    under locks, condition waits, unjoined threads)
+R2xx    asynclint   event-loop hazards (blocking in coroutines,
+                    fire-and-forget tasks, cross-thread loop access,
+                    await under threading locks, swallowed cancel)
+======  ==========  ==================================================
+
+Exit codes follow linter convention: 0 clean (suppressed findings are
+clean), 1 unsuppressed findings, 2 usage or parse error. ``--json``
+emits the machine rendering with the family breakdown folded into the
+summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional
+
+from waternet_tpu.analysis import lint_models, parse_model
+from waternet_tpu.analysis.core import collect_py_files
+from waternet_tpu.analysis.registry import RULES
+from waternet_tpu.analysis.report import summarize
+
+#: The repo's lint surface: the package, the CLIs, and the tools — the
+#: same set the tier-1 repo-clean gates pin (tests/test_*lint*.py).
+DEFAULT_TARGETS = (
+    "waternet_tpu",
+    "train.py",
+    "score.py",
+    "inference.py",
+    "bench.py",
+    "tools",
+)
+
+_FAMILIES = (("R0", "jaxlint"), ("R1", "threadlint"), ("R2", "asynclint"))
+
+
+def family_of(rule_id: str) -> str:
+    for prefix, name in _FAMILIES:
+        if rule_id.startswith(prefix):
+            return name
+    return "other"
+
+
+def family_summary(findings) -> dict:
+    """``{family: {"findings": n, "unsuppressed": n}}`` for every family
+    that has at least one registered rule (zeroes included, so a family
+    going silent is visible in CI diffs)."""
+    out = {
+        name: {"findings": 0, "unsuppressed": 0}
+        for _prefix, name in _FAMILIES
+        if any(family_of(rid) == name for rid in RULES)
+    }
+    for f in findings:
+        fam = out.setdefault(
+            family_of(f.rule), {"findings": 0, "unsuppressed": 0}
+        )
+        fam["findings"] += 1
+        if not f.suppressed:
+            fam["unsuppressed"] += 1
+    return out
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="waternet-lint",
+        description=(
+            "Run every rule family (jaxlint R0xx, threadlint R1xx, "
+            "asynclint R2xx) over the repo lint surface in one pass "
+            "with a merged report and a single exit code — docs/LINT.md."
+        ),
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help=(
+            "Python files and/or directories; default is the repo lint "
+            f"surface ({', '.join(DEFAULT_TARGETS)}) resolved against "
+            "the current directory"
+        ),
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    p.add_argument(
+        "--rules",
+        type=str,
+        default=None,
+        metavar="R201,R102",
+        help="run only these rules (default: all registered rules)",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print suppressed findings in the text rendering",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalogue grouped by family",
+    )
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = parse_args(argv)
+    if args.list_rules:
+        current = None
+        for rid, rule in sorted(RULES.items()):
+            fam = family_of(rid)
+            if fam != current:
+                print(f"[{fam}]")
+                current = fam
+            print(f"{rid}  {rule.name}: {rule.description}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(
+                f"waternet-lint: unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(RULES))})",
+                file=sys.stderr,
+            )
+            return 2
+
+    paths = args.paths
+    if not paths:
+        paths = [t for t in DEFAULT_TARGETS if Path(t).exists()]
+        if not paths:
+            print(
+                "waternet-lint: none of the default targets exist here "
+                "(run from the repo root or pass paths)",
+                file=sys.stderr,
+            )
+            return 2
+    try:
+        files = collect_py_files(paths)
+    except FileNotFoundError as err:
+        print(str(err), file=sys.stderr)
+        return 2
+    models = []
+    for f in files:
+        try:
+            models.append(parse_model(f))
+        except SyntaxError as err:
+            print(f"waternet-lint: cannot parse {f}: {err}", file=sys.stderr)
+            return 2
+
+    findings = lint_models(models, rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    summary = summarize(findings, len(files))
+    summary["families"] = family_summary(findings)
+
+    if args.json:
+        payload = {
+            "summary": summary,
+            "rules": {
+                rid: {
+                    "family": family_of(rid),
+                    "name": rule.name,
+                    "description": rule.description,
+                }
+                for rid, rule in sorted(RULES.items())
+            },
+            "findings": [f.as_dict() for f in findings],
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in findings:
+            if args.show_suppressed or not f.suppressed:
+                print(f.render())
+        for name, fam in summary["families"].items():
+            print(
+                f"waternet-lint [{name}]: {fam['unsuppressed']} finding(s), "
+                f"{fam['findings'] - fam['unsuppressed']} suppressed"
+            )
+        print(
+            f"waternet-lint: {summary['files_scanned']} file(s), "
+            f"{summary['unsuppressed']} finding(s), "
+            f"{summary['suppressed']} suppressed"
+        )
+    return 1 if summary["unsuppressed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,7 @@ contract.
 """
 
 from waternet_tpu.analysis.rules import (  # noqa: F401
+    asynclint,
     concurrency,
     donation,
     hostsync,

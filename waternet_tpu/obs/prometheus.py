@@ -246,6 +246,26 @@ def render_prometheus(summary: dict) -> str:
               "Params generation (bumped by each /admin/reload "
               "invalidation).", cache["generation"])
 
+    # --- event-loop lag (--obs-loop-lag; .get keeps older summaries legal)
+    loop_lag = summary.get("loop_lag")
+    if loop_lag:
+        w.one("waternet_loop_lag_enabled", "gauge",
+              "1 when the Handle._run loop-lag sampler is armed "
+              "(--obs-loop-lag).", loop_lag["enabled"])
+        w.one("waternet_loop_lag_max_ms", "gauge",
+              "Longest single event-loop callback observed, ms.",
+              loop_lag["max_ms"])
+        w.one("waternet_loop_lag_p99_ms", "gauge",
+              "p99 event-loop callback wall time over the retained "
+              "sample window, ms.", loop_lag["p99_ms"])
+        w.one("waternet_loop_callbacks_total", "counter",
+              "Event-loop callbacks timed by the sampler.",
+              loop_lag["callbacks"])
+        w.one("waternet_loop_stalls_total", "counter",
+              "Callbacks past the sampler's stall threshold (infinite "
+              "by default in production: gauges only).",
+              loop_lag["stalls"])
+
     per_replica = summary["per_replica"]
     w.metric(
         "waternet_replica_requests_total", "counter",

@@ -116,7 +116,7 @@ def block_flow(
     return best
 
 
-def shift_frame(frame: np.ndarray, dx: float, dy: float) -> np.ndarray:
+def shift_frame(frame: np.ndarray, dx: float, dy: float) -> np.ndarray:  # loop-blocking: full-resolution numpy warp, milliseconds per frame
     """Motion-compensate ``frame`` by a constant backward flow
     ``(dx, dy)`` pixels (metrics/flicker.py warp semantics). Pixels
     whose source falls outside the frame keep their un-warped value —
@@ -144,7 +144,13 @@ class FrameDeltaGate:
     run on the session's reader task and ``note_computed``/
     ``materialize`` on its writer task, both on the same asyncio event
     loop thread — no concurrent access is possible, so the state below
-    is deliberately unlocked.
+    is deliberately unlocked. ``materialize`` may additionally run on
+    an executor thread *on the writer task's behalf* (the full-frame
+    warp is too heavy for the event loop — asynclint R201): that stays
+    race-free because it only reads the writer-confined fields
+    (``_enhanced``/``_flags``/``_computed_seq``) and the writer task is
+    suspended awaiting it, while the reader task touches only its own
+    fields (``_small``/``_shape``/``_anchor_seq``/``_run``).
 
     Protocol (see module docstring for why decision and answer are
     split): the reader calls ``check(rgb)`` per frame — ``None`` means

@@ -246,6 +246,7 @@ class ServingServer:
         stream_reuse_threshold: Optional[float] = None,
         stream_max_reuse_run: int = DEFAULT_MAX_REUSE_RUN,
         response_cache: int = 0,
+        obs_loop_lag: bool = False,
     ):
         if admit_watermark is None:
             # Shed before QueueFull would fire: the watermark is the soft
@@ -295,6 +296,13 @@ class ServingServer:
         )
         if self.response_cache is not None:
             self.stats.cache_probe = self.response_cache.counters
+        # Event-loop-lag sampler (--obs-loop-lag, default off): a
+        # LoopTracer with an infinite threshold — gauges only, never
+        # raises — feeding the loop_lag block on /stats and /metrics.
+        # Installed/armed in _main, so the probe reports zeros until
+        # the loop actually serves.
+        self.obs_loop_lag = bool(obs_loop_lag)
+        self._loop_tracer = None
         self.slo_spec = slo
         if slo:
             from waternet_tpu.obs.slo import SloEngine, parse_slo
@@ -387,6 +395,18 @@ class ServingServer:
             guard.__enter__()
         server = None
         beat_task = None
+        if self.obs_loop_lag:
+            from waternet_tpu.analysis.looptrace import LoopTracer
+
+            # Infinite threshold: production sampling records max/p99
+            # lag for the loop_lag gauge but never raises — the test
+            # fixture (conftest looptrace) is where thresholds fail.
+            self._loop_tracer = LoopTracer(threshold_ms=float("inf"))
+            self._loop_tracer.install()
+            tracer = self._loop_tracer
+            self.stats.loop_lag_probe = lambda: {
+                "enabled": True, **tracer.gauge()
+            }
         try:
             server = await asyncio.start_server(
                 self._handle_connection, self.host, self.port
@@ -501,6 +521,8 @@ class ServingServer:
             await asyncio.sleep(0.05)
             return 0 if clean else 1
         finally:
+            if self._loop_tracer is not None:
+                self._loop_tracer.uninstall()
             if beat_task is not None:
                 beat_task.cancel()
             if server is not None:
@@ -753,7 +775,7 @@ class ServingServer:
             # Blocking the LOOP thread is the point: /healthz, the beat
             # task, and every open connection freeze together, which is
             # exactly the wedge the router's hang detection must catch.
-            gate.hang.wait()
+            gate.hang.wait()  # jaxlint: disable=R201 fault injection: wedging the loop IS the test
 
         t_req0 = time.perf_counter() if trace.enabled() else None
         if self.draining.is_set():
@@ -1332,6 +1354,14 @@ def parse_args(argv=None):
         "stamp X-Cache: hit. 0 (the default) disables the cache.",
     )
     parser.add_argument(
+        "--obs-loop-lag", action="store_true",
+        help="Sample event-loop callback wall time (a Handle._run wrap, "
+        "docs/LINT.md 'Asyncio rules') and expose max/p99 loop lag as "
+        "the loop_lag block on /stats and waternet_loop_lag_* gauges "
+        "on /metrics. Off by default: the wrap costs one perf_counter "
+        "pair per callback.",
+    )
+    parser.add_argument(
         "--slo", type=str, default=None, metavar="SPEC",
         help="Arm the SLO engine with a comma-separated objective list, "
         'e.g. "p99_ms<=250,error_rate<=0.01,availability>=0.999". '
@@ -1415,6 +1445,7 @@ def main(argv=None) -> int:
         stream_reuse_threshold=args.stream_reuse_threshold,
         stream_max_reuse_run=args.stream_max_reuse_run,
         response_cache=args.response_cache,
+        obs_loop_lag=args.obs_loop_lag,
     )
     return server.run(install_signal_handlers=True)
 

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 
 from waternet_tpu.obs import window as obswin
 from waternet_tpu.obs.slo import SloEngine, WindowSample
+from waternet_tpu.analysis.looptrace import empty_loop_lag_block
 from waternet_tpu.serving.reuse import empty_cache_block
 
 #: Latency reservoir size: percentiles are computed over at most this many
@@ -258,6 +259,12 @@ class ServingStats:
         #: the summary reports the all-zeros enabled:false block — most
         #: servers run without a cache.
         self.cache_probe = None
+        #: Live event-loop-lag gauge: a zero-arg callable the owning
+        #: server registers when ``--obs-loop-lag`` is on (a LoopTracer
+        #: with an infinite threshold wrapping Handle._run — docs/
+        #: LINT.md "Asyncio rules"). Left None, the summary reports the
+        #: all-zeros enabled:false block — sampling is opt-in.
+        self.loop_lag_probe = None
 
     def declare_tier(self, tier: str) -> None:
         """Register a serving tier up front (a ReplicaPool does this at
@@ -584,6 +591,7 @@ class ServingStats:
             tiers = {name: dict(c) for name, c in self._tiers.items()}
             stream_probe = self.stream_probe
             cache_probe = self.cache_probe
+            loop_lag_probe = self.loop_lag_probe
             streams = {
                 "opened": self.streams_opened,
                 "refused": self.streams_refused,
@@ -633,6 +641,10 @@ class ServingStats:
             "cache": (
                 cache_probe() if cache_probe is not None
                 else empty_cache_block()
+            ),
+            "loop_lag": (
+                loop_lag_probe() if loop_lag_probe is not None
+                else empty_loop_lag_block()
             ),
             "per_replica": self.per_replica(),
             "window": self.window.block(),

@@ -263,7 +263,10 @@ class StreamSession:
         self.lat_s: List[float] = []  # delivered-frame latency sample
         # Temporal gating (off unless the session/server enabled it):
         # reader task checks, writer task anchors — same event loop,
-        # so the gate needs no lock.
+        # so the gate needs no lock. The one exception is materialize,
+        # which the writer task awaits on an executor thread (the warp
+        # is too heavy for the loop); see FrameDeltaGate's docstring
+        # for why that stays race-free.
         self.gate = (
             FrameDeltaGate(
                 cfg.reuse_threshold,
@@ -433,7 +436,14 @@ class StreamSession:
     async def _deliver(self, entry: _Frame) -> None:
         loop = asyncio.get_running_loop()
         if entry.reused is not None:
-            hit = self.gate.materialize(entry.reused)
+            # The warped replay is full-resolution numpy work (R201:
+            # shift_frame is declared loop-blocking), so it runs on the
+            # executor. Safe off-loop: materialize reads only the
+            # writer-confined fields (_enhanced/_flags/_computed_seq)
+            # and this writer task is suspended until it returns.
+            hit = await loop.run_in_executor(
+                None, self.gate.materialize, entry.reused
+            )
             if hit is not None:
                 # Temporal reuse: answer from the anchor's enhanced
                 # frame — encode and write the R record (byte-identical
