@@ -404,6 +404,7 @@ def test_stats_schema_and_latency_percentiles():
         "shed_count", "deadline_expired", "retried", "downgraded",
         "nan_outputs", "quarantines", "reintegrations",
         "recovery_sec_max", "replica_health", "queue_depth",
+        "eff_wait_ms",
         "queue_depth_mean", "queue_depth_max", "replicas",
         "images_per_sec", "load_imbalance", "tiers", "streams",
         "cache", "loop_lag", "per_replica", "window", "slo",
@@ -977,6 +978,7 @@ def test_bench_serving_multi_scales_on_multicore():
     [("serve", "mixed_res_dir_images_per_sec"),
      ("serve_multi", "mixed_res_dir_images_per_sec_multidev"),
      ("serve_http", "http_images_per_sec"),
+     ("serve_adaptive", "adaptive_p50_ms"),
      ("serve_chaos", "chaos_images_per_sec"),
      ("train_chaos", "chaos_train_images_per_sec"),
      ("tiers", "fast_tier_images_per_sec"),

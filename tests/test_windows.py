@@ -444,6 +444,33 @@ def test_bench_history_stream_reuse_fps_direction(tmp_path, capsys):
     assert "REGRESSIONS" in out and "value" in out
 
 
+def test_bench_history_adaptive_p50_direction(tmp_path, capsys):
+    """adaptive_p50_ms is a LATENCY contract line: its headline
+    ``value`` must be re-keyed under the metric name so the ``_ms``
+    suffix grades it lower-better — a p50 RISE flags, a drop never
+    does (the default ``value`` series is higher-better and would
+    grade it backwards)."""
+    from tools import bench_history
+
+    assert bench_history.metric_direction("adaptive_p50_ms") == -1
+    _write_round(tmp_path, 1, {"metric": "adaptive_p50_ms",
+                               "value": 4.0, "throughput_ratio": 1.0})
+    _write_round(tmp_path, 2, {"metric": "adaptive_p50_ms",
+                               "value": 9.0, "throughput_ratio": 1.0})
+    assert bench_history.main(
+        ["--root", str(tmp_path), "--threshold-pct", "10"]
+    ) == 1
+    out = capsys.readouterr().out
+    assert "adaptive_p50_ms" in out
+    # The p50 IMPROVING (and any other keys riding along) must not flag.
+    _write_round(tmp_path, 3, {"metric": "adaptive_p50_ms",
+                               "value": 3.0, "throughput_ratio": 1.0})
+    assert bench_history.main(
+        ["--root", str(tmp_path), "--threshold-pct", "10"]
+    ) == 0
+    capsys.readouterr()
+
+
 def test_bench_history_all_error_rounds_rc0(tmp_path, capsys):
     """The committed repo state today: every round is an error round
     (chip unreachable). That is a tunnel problem, not a perf regression

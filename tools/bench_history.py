@@ -87,6 +87,17 @@ def bench_round_values(doc: dict) -> Tuple[Dict[str, float], bool]:
         for k, v in source.items()
         if isinstance(v, (int, float)) and not isinstance(v, bool)
     }
+    metric = source.get("metric")
+    if (
+        isinstance(metric, str)
+        and "value" in vals
+        and metric_direction(metric) == -1
+    ):
+        # A latency-style contract line (adaptive_p50_ms): its headline
+        # figure is LOWER-better, so it must not ride the default
+        # higher-better "value" series — re-key it under its own name
+        # and the suffix rule grades it correctly.
+        vals[metric] = vals.pop("value")
     return vals, stale
 
 

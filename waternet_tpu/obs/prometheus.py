@@ -156,6 +156,15 @@ def render_prometheus(summary: dict) -> str:
           summary["recovery_sec_max"])
     w.one("waternet_queue_depth", "gauge",
           "Current batcher queue depth.", summary["queue_depth"])
+    # --- adaptive coalescing (.get keeps older summaries legal)
+    w.metric(
+        "waternet_eff_wait_ms", "gauge",
+        "Live effective coalescing window per tier (ms) — the "
+        "max_wait_ms cap under --coalesce fixed, the controller's "
+        "load-aware window under adaptive.",
+        [({"tier": tier}, v)
+         for tier, v in sorted(summary.get("eff_wait_ms", {}).items())],
+    )
     w.one("waternet_queue_depth_mean", "gauge",
           "Mean queue depth sampled at admissions.",
           summary["queue_depth_mean"])

@@ -44,6 +44,7 @@ import numpy as np
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.obs import trace
+from waternet_tpu.serving.adaptive import CoalesceController
 from waternet_tpu.serving.bucketing import BucketLadder
 from waternet_tpu.serving.replicas import (
     ReplicaPool,
@@ -133,11 +134,20 @@ class DynamicBatcher:
 
     * ``max_batch`` — compiled batch-slot count per bucket (with
       ``data_shards`` engines, make it a multiple of the shard count);
-    * ``max_wait_ms`` — once a bucket's oldest admitted request has
-      waited this long for batchmates, the partial batch flushes: the
-      latency/occupancy dial. The clock starts at dispatcher admission,
+    * ``max_wait_ms`` — the coalescing CAP: the longest a bucket's
+      oldest admitted request may wait for batchmates before the
+      partial batch flushes. The clock starts at dispatcher admission,
       so it bounds coalescing delay specifically — queueing delay under
-      overload is capacity-bound and shared by all traffic;
+      overload is capacity-bound and shared by all traffic. With
+      ``coalesce="fixed"`` (the library default) the effective window
+      IS the cap — the historical constant hold. With
+      ``coalesce="adaptive"`` (the serving CLI default) a per-(tier,
+      bucket) :class:`~waternet_tpu.serving.adaptive.CoalesceController`
+      sets the effective window inside [0, cap] from the EWMA arrival
+      rate: an empty-queue request flushes immediately (its p50 drops
+      by ~the cap) and the window grows toward the cap as load rises
+      (occupancy preserved). Either way, per-request deadlines clamp
+      the effective window identically;
     * ``replicas`` — serving devices (``'auto'`` = every local device;
       sharded engines always resolve to 1 — their executable spans the
       mesh). Each flush goes to the least-loaded replica;
@@ -179,6 +189,7 @@ class DynamicBatcher:
         tier_name: str = "quality",
         supervision: Optional[SupervisionConfig] = None,
         downgrade_watermark: Optional[int] = None,
+        coalesce: str = "fixed",
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -215,6 +226,12 @@ class DynamicBatcher:
             )
         self.ladder = ladder = fit_ladder_to_engine(ladder, engine)
         self.max_wait_s = float(max_wait_ms) / 1e3
+        # Effective-window authority: fixed mode returns the cap from
+        # every read — byte- and timing-identical to the historical
+        # constant hold; adaptive mode shrinks/grows inside [0, cap]
+        # from the EWMA arrival rate (serving/adaptive.py). Validates
+        # the mode name loudly here, at construction.
+        self._coalesce = CoalesceController(self.max_wait_s, mode=coalesce)
         self.stats = stats if stats is not None else ServingStats()
         # No request ever pays a compile: the whole per-replica executable
         # grid is built before the first submit is accepted.
@@ -274,6 +291,7 @@ class DynamicBatcher:
         self._backlog = 0  # guarded-by: self._submit_lock
         self.stats.queue_depth_probe = self.queue_depth
         self.stats.replica_health_probe = self.health
+        self.stats.eff_wait_probe = self._coalesce.eff_wait_ms
         # Makes the closed-check + enqueue atomic vs close(): without it a
         # racing submit() could land its request BEHIND the _CLOSE
         # sentinel, where the dispatcher never looks — the caller would
@@ -285,6 +303,18 @@ class DynamicBatcher:
             daemon=True,
         )
         self._dispatcher.start()
+
+    @property
+    def coalesce_mode(self) -> str:
+        """The configured coalescing mode: "fixed" (constant hold at the
+        ``max_wait_ms`` cap) or "adaptive" (load-aware window inside
+        [0, cap]) — surfaced in the server banner and /stats config."""
+        return self._coalesce.mode
+
+    def eff_wait_ms(self) -> dict:
+        """Live per-tier effective coalescing window (ms) — the
+        ``eff_wait_ms`` gauge of /stats and /metrics."""
+        return self._coalesce.eff_wait_ms()
 
     @property
     def n_replicas(self) -> int:
@@ -563,41 +593,78 @@ class DynamicBatcher:
         h, w = req.image.shape[:2]
         bucket = self.ladder.bucket_for(h, w)
         # Coalescing is per (tier, bucket): tiers never share a device
-        # batch — a micro-batch runs ONE model on one executable.
+        # batch — a micro-batch runs ONE model on one executable. The
+        # controller sees every admission: its arrival-rate estimate is
+        # what sizes the NEXT effective window for this key.
         key = (req.tier, bucket)
+        self._coalesce.observe_arrival(req.tier, bucket, req.t_admit)
         pending.setdefault(key, []).append(req)
         if bucket is None or len(pending[key]) >= self.max_batch:
             self._flush(key, pending.pop(key))
 
-    def _eff_deadline(self, req: _Request) -> float:
+    def _eff_deadline(self, req: _Request, window_s: float) -> float:
         """When this request's bucket must flush on its account: the
-        max_wait coalescing budget, CLAMPED by the request's own deadline
-        — a request with 5 ms left never waits out a 20 ms window it
-        cannot afford."""
-        t = req.t_admit + self.max_wait_s
+        effective coalescing budget (``window_s`` — the cap under fixed
+        mode, the controller's load-aware window under adaptive),
+        CLAMPED by the request's own deadline — a request with 5 ms
+        left never waits out a 20 ms window it cannot afford."""
+        t = req.t_admit + window_s
         if req.deadline is not None:
             t = min(t, req.deadline)
         return t
 
+    def _window_for(self, key, now: float, busy_cache: dict) -> float:
+        """The effective coalescing window for one (tier, bucket): the
+        controller's load-aware window, EXTENDED back to the cap while
+        every replica of the tier is busy. The extension is
+        work-conserving: with no idle replica, flushing a partial bucket
+        early cannot start its compute any sooner — the batch would sit
+        in the pool queue while its (slot-padded, so full-price) partial
+        fill is locked in. Held buckets still flush the instant they
+        fill (``_admit``) and each request's own deadline still clamps
+        in ``_eff_deadline``. Fixed mode already sits at the cap, so the
+        probe is skipped and behavior is bit-for-bit the historical
+        hold. ``busy_cache`` memoizes one pool probe per tier per
+        dispatcher pass."""
+        tier, bucket = key
+        w = self._coalesce.window_s(tier, bucket, now)
+        if w >= self.max_wait_s:
+            return w
+        busy = busy_cache.get(tier)
+        if busy is None:
+            busy = not self._pools[tier].has_idle_replica()
+            busy_cache[tier] = busy
+        return self.max_wait_s if busy else w
+
     def _sweep(self, pending: dict) -> None:
         """Flush every bucket holding a request whose effective deadline
         (coalescing budget clamped by its own deadline) has passed
-        (cheap: O(pending requests) clock checks)."""
+        (cheap: O(pending requests) clock checks, one controller read
+        per pending bucket, at most one pool-idleness probe per tier)."""
         now = time.perf_counter()
+        busy_cache: dict = {}
         for key in list(pending):
             reqs = pending[key]
-            if reqs and min(self._eff_deadline(r) for r in reqs) <= now:
+            if not reqs:
+                continue
+            w = self._window_for(key, now, busy_cache)
+            if min(self._eff_deadline(r, w) for r in reqs) <= now:
                 self._flush(key, pending.pop(key))
 
     def _next_deadline(self, pending: dict) -> Optional[float]:
         soonest = None
-        for reqs in pending.values():
+        now = time.perf_counter()
+        busy_cache: dict = {}
+        for key, reqs in pending.items():
+            if not reqs:
+                continue
+            w = self._window_for(key, now, busy_cache)
             for r in reqs:
-                t = self._eff_deadline(r)
+                t = self._eff_deadline(r, w)
                 soonest = t if soonest is None else min(soonest, t)
         if soonest is None:
             return None  # idle: block until the next request
-        return max(0.0, soonest - time.perf_counter())
+        return max(0.0, soonest - now)
 
     def _flush(self, key, reqs: List[_Request]) -> None:
         """Hand one coalesced micro-batch to its tier's least-loaded
@@ -636,14 +703,23 @@ class DynamicBatcher:
                     )
             else:
                 live.append(r)
+        if bucket is not None and live:
+            # Occupancy feedback: what this flush's fill looked like —
+            # the controller's EWMA gauge (bench serve_adaptive reports
+            # it). Fallback natives (bucket None) always flush alone
+            # and would only skew the gauge.
+            self._coalesce.observe_flush(tier, len(live) / self.max_batch)
         if trace.enabled():
-            # Coalesce: admission -> flush, per surviving request; the
+            # Coalesce: admission -> flush, per surviving request, each
+            # carrying the wait it actually paid (eff_wait_ms — the
+            # adaptive win is visible per request in traces); the
             # dropped ones get instants so a trace explains the gap.
             for r in live:
                 trace.record_span(
                     "coalesce", "serving", r.t_admit, now,
                     args={"request_id": r.req_id, "tier": tier,
-                          "bucket": str(bucket)},
+                          "bucket": str(bucket),
+                          "eff_wait_ms": round((now - r.t_admit) * 1e3, 3)},
                 )
             for r in reqs:
                 if r not in live and r.future.done():

@@ -33,6 +33,20 @@ earlier PRs into a front tier (docs/SERVING.md "Fleet"):
   transition is logged with its triggering objective and surfaced on the
   router's ``/stats``, ``/healthz`` (per-worker health map), and
   ``/metrics``.
+* **Forecast-driven autoscaling** — when the armed SLO has a latency
+  objective, a :class:`waternet_tpu.serving.adaptive.QueueForecaster`
+  tracks aggregate worker queue depth each control tick and scales the
+  fleet up on a *predicted* objective breach — BEFORE the burn-rate
+  engine pages, so capacity lands ahead of the brown-out rung — and
+  down on a sustained low forecast. Forecast actions share the burn
+  loop's scale cooldown (one scaler, two triggers) and never touch the
+  brown-out policy; they log as ``forecast_scale_up`` /
+  ``forecast_scale_down``.
+* **Copy-lean relay** — ``/enhance`` worker answers stream through the
+  router in 64 KiB chunks once the response head has parsed, instead of
+  being rebuffered whole; the full body is tee-accumulated only when
+  the router response cache will store it. A worker that dies before
+  the head commits still re-dispatches exactly as before.
 
 The router itself is stdlib-only — hand-rolled asyncio HTTP, no model,
 no jax — so it stays cheap to run next to the workers and trivially
@@ -65,6 +79,10 @@ from waternet_tpu.data.pipeline import THREAD_PREFIX
 from waternet_tpu.obs import trace
 from waternet_tpu.obs import window as obswin
 from waternet_tpu.obs.slo import SloEngine, WindowSample, parse_slo
+from waternet_tpu.serving.adaptive import (
+    QueueForecaster,
+    empty_forecast_block,
+)
 from waternet_tpu.resilience.heartbeat import (
     ENV_HEARTBEAT_DIR,
     ENV_HEARTBEAT_SEC,
@@ -260,6 +278,18 @@ class FleetPolicy:
             or now - self._last_scale >= self.cooldown_sec
         )
 
+    # The forecast path scales through the SAME cooldown ledger: a burn
+    # scale and a forecast scale are one fleet-level actuator, so one
+    # anti-flap term must gate both.
+    def cooled(self, now: float) -> bool:
+        """True when the scale cooldown allows another action at ``now``."""
+        return self._cooled(now)
+
+    def note_scale(self, now: float) -> None:
+        """Record an external (forecast-driven) scale action so the
+        cooldown applies to the next decision from either trigger."""
+        self._last_scale = now
+
     def step(self, now: float, slo_state: str, n_workers: int) -> List[str]:
         """Actions for one control tick: any of ``brownout`` /
         ``restore`` / ``scale_up`` / ``scale_down``, in apply order.
@@ -398,6 +428,28 @@ class FleetWorker:
         }
 
 
+class _ClientSink:
+    """Client-side target for the copy-lean /enhance relay.
+
+    Once a worker response head parses, ``begin`` commits the head to
+    the client and body chunks are pumped straight through — the router
+    never rebuffers the whole answer. ``tee`` collects the chunks ONLY
+    when the head callback decided the router cache will store the body;
+    ``committed`` tells the dispatch loop a redispatch is no longer
+    possible (bytes are on the wire). Event-loop-only state: no lock.
+    """
+
+    def __init__(self, writer, head_fn):
+        self.writer = writer
+        self._head_fn = head_fn
+        self.committed = False
+        self.tee: Optional[List[bytes]] = None
+
+    def begin(self, status: int, ctype: str, relay, length: int) -> None:
+        self.committed = True
+        self.tee = self._head_fn(status, ctype, relay, length)
+
+
 # ----------------------------------------------------------------------
 # The router
 # ----------------------------------------------------------------------
@@ -436,6 +488,10 @@ class FleetRouter:
         slo_long_sec: float = obswin.DEFAULT_LONG_WINDOW_SEC,
         slo_hold_sec: float = 60.0,
         scale_cooldown_sec: float = 30.0,
+        forecast: bool = True,
+        forecast_horizon_sec: float = 30.0,
+        forecast_up_sustain: int = 2,
+        forecast_down_sustain: int = 6,
         brownout_watermark: int = 1,
         heartbeat_root=None,
         worker_faults: Optional[Dict[Tuple[int, int], str]] = None,
@@ -492,6 +548,29 @@ class FleetRouter:
         )
         self._policy = FleetPolicy(
             self.n_workers, self.max_workers, cooldown_sec=scale_cooldown_sec
+        )
+        # Queue-depth forecaster: armed only when the SLO carries a
+        # latency objective — its threshold IS the drain-time budget the
+        # Little's-law breach depth is computed against. The burn engine
+        # stays authoritative for paging and brown-out; the forecaster
+        # only moves capacity earlier (monitor thread is the sole
+        # caller, so the forecaster needs no lock).
+        lat_ms = None
+        if forecast and self._slo is not None:
+            lats = [
+                o.threshold for o in self._slo.objectives
+                if o.kind == "latency"
+            ]
+            lat_ms = min(lats) if lats else None
+        self._forecaster = (
+            QueueForecaster(
+                lat_ms,
+                horizon_sec=forecast_horizon_sec,
+                up_sustain=forecast_up_sustain,
+                down_sustain=forecast_down_sustain,
+            )
+            if lat_ms is not None
+            else None
         )
         # Router-level content-addressed /enhance cache. Keys include a
         # ladder identity of "fleet" rather than the bucket ladder (the
@@ -885,7 +964,8 @@ class FleetRouter:
                 **{"from": tr["from"], "to": tr["to"]},
             )
         objective = self._paging_objective(block) or block["state"]
-        for action in self._policy.step(now, block["state"], n_live):
+        actions = self._policy.step(now, block["state"], n_live)
+        for action in actions:
             if action == "brownout":
                 self._apply_brownout(now, objective)
             elif action == "restore":
@@ -894,6 +974,54 @@ class FleetRouter:
                 self._apply_scale_up(now, objective, n_live)
             elif action == "scale_down":
                 self._apply_scale_down(now, objective, n_live)
+        self._forecast_tick(now, block, n_live, actions)
+
+    def _forecast_tick(
+        self, now: float, block: dict, n_live: int, burn_actions: List[str]
+    ) -> None:
+        """Predictive half of the control loop: aggregate polled queue
+        depth -> forecaster -> early scale hint. Runs AFTER the burn
+        policy so a paging fleet is already handled; forecast actions
+        share the policy's cooldown and never touch brown-out."""
+        if self._forecaster is None:
+            return
+        with self._lock:
+            depth = sum(
+                w.queue_depth + w.inflight
+                for w in self._workers.values()
+                if not w.failed and not w.retiring
+            )
+        span = max(self.slo_short_sec, 1e-6)
+        service_rate = self._windows.ok.total(self.slo_short_sec) / span
+        hint = self._forecaster.step(now, depth, service_rate)
+        if hint is None or any(
+            a in ("scale_up", "scale_down") for a in burn_actions
+        ):
+            return
+        if (
+            hint == "scale_up"
+            and block["state"] != "page"
+            and n_live < self.max_workers
+            and self._policy.cooled(now)
+        ):
+            self._policy.note_scale(now)
+            self._apply_scale_up(
+                now, "queue_forecast", n_live, event="forecast_scale_up",
+            )
+        elif (
+            # "warn" is included: the burn policy holds position there,
+            # so a sustained-low forecast is the only voice that can
+            # shrink an over-provisioned warn-state fleet.
+            hint == "scale_down"
+            and block["state"] in ("ok", "warn")
+            and not self._policy.brownout
+            and n_live > self._policy.min_workers
+            and self._policy.cooled(now)
+        ):
+            self._policy.note_scale(now)
+            self._apply_scale_down(
+                now, "queue_forecast", n_live, event="forecast_scale_down",
+            )
 
     def _ready_workers(self) -> List[FleetWorker]:
         with self._lock:
@@ -919,12 +1047,15 @@ class FleetRouter:
             self._apply_policy(w, w.baseline_downgrade)
         self._log_event(now, event="restore", objective=objective)
 
-    def _apply_scale_up(self, now: float, objective: str, n_live: int) -> None:
+    def _apply_scale_up(
+        self, now: float, objective: str, n_live: int,
+        event: str = "scale_up",
+    ) -> None:
         with self._lock:
             slot = self._next_slot
             self._next_slot += 1
         self._log_event(
-            now, event="scale_up", objective=objective,
+            now, event=event, objective=objective,
             workers=n_live + 1, slot=slot,
         )
         # The brown-out policy (if active) lands on the new worker when
@@ -932,7 +1063,8 @@ class FleetRouter:
         self._spawn_worker(slot, 0)
 
     def _apply_scale_down(
-        self, now: float, objective: str, n_live: int
+        self, now: float, objective: str, n_live: int,
+        event: str = "scale_down",
     ) -> None:
         # Retire the highest live slot: deterministic choice, and the
         # base slots (0..n_workers-1) are never the ones retired.
@@ -955,7 +1087,7 @@ class FleetRouter:
         except OSError:
             pass
         self._log_event(
-            now, event="scale_down", objective=objective,
+            now, event=event, objective=objective,
             workers=n_live - 1, worker=w.worker_id,
         )
 
@@ -998,6 +1130,11 @@ class FleetRouter:
                     if self.response_cache is not None
                     else empty_cache_block()
                 ),
+                "forecast": (
+                    self._forecaster.block()
+                    if self._forecaster is not None
+                    else empty_forecast_block()
+                ),
             }
             workers = {
                 w.worker_id: w.summary() for w in self._workers.values()
@@ -1009,7 +1146,11 @@ class FleetRouter:
             }
             slo_block = self._slo_block
         fleet["scale_events"] = [
-            e for e in events if e.get("event") in ("scale_up", "scale_down")
+            e for e in events
+            if e.get("event") in (
+                "scale_up", "scale_down",
+                "forecast_scale_up", "forecast_scale_down",
+            )
         ]
         fleet["events"] = events[-100:]
         return {
@@ -1321,12 +1462,15 @@ class FleetRouter:
 
     async def _relay_enhance(
         self, w: FleetWorker, path: str, headers: dict, body: bytes,
-        req_id: str,
+        req_id: str, sink: Optional[_ClientSink] = None,
     ):
-        """One relay attempt. Returns (status, relay_headers, body) or
-        None on a demonstrable transport failure (connect error, torn
-        response, worker declared down mid-read, per-attempt timeout) —
-        the caller re-dispatches those; worker ANSWERS always relay."""
+        """One relay attempt. Returns (status, ctype, relay_headers,
+        body) or None on a demonstrable transport failure (connect
+        error, torn response, worker declared down mid-read, per-attempt
+        timeout) — the caller re-dispatches those; worker ANSWERS always
+        relay. With a ``sink``, the body streams to the client as it
+        arrives (returned body is None) and ``sink.committed`` marks the
+        point of no redispatch."""
         try:
             wreader, wwriter = await asyncio.open_connection(
                 "127.0.0.1", w.port
@@ -1347,7 +1491,9 @@ class FleetRouter:
             await wwriter.drain()
             if w.down_event is None:
                 w.down_event = asyncio.Event()
-            read = asyncio.ensure_future(self._read_worker_response(wreader))
+            read = asyncio.ensure_future(
+                self._read_worker_response(wreader, sink)
+            )
             down = asyncio.ensure_future(w.down_event.wait())
             done, pending = await asyncio.wait(
                 {read, down},
@@ -1376,7 +1522,9 @@ class FleetRouter:
             except Exception:
                 pass
 
-    async def _read_worker_response(self, wreader):
+    async def _read_worker_response(
+        self, wreader, sink: Optional[_ClientSink] = None
+    ):
         line = await wreader.readline()
         parts = line.decode("latin-1").split()
         if len(parts) < 2:
@@ -1390,14 +1538,64 @@ class FleetRouter:
             name, _, value = line.decode("latin-1").partition(":")
             headers[name.strip().lower()] = value.strip()
         length = _content_length(headers)
-        body = await wreader.readexactly(length) if length else b""
         relay = tuple(
             (name.title(), headers[name])
             for name in _RELAY_HEADERS
             if name in headers and name != "content-type"
         )
-        return status, headers.get("content-type", "application/json"), \
-            relay, body
+        ctype = headers.get("content-type", "application/json")
+        if sink is None:
+            body = await wreader.readexactly(length) if length else b""
+            return status, ctype, relay, body
+        # Copy-lean path: the head is committed to the client the moment
+        # it parses, then the body pumps through in 64 KiB chunks (the
+        # /stream relay's unit) — the router never holds the full
+        # answer. Tee-accumulate only when the sink's head callback
+        # asked for the bytes back (a router cache put).
+        sink.begin(status, ctype, relay, length)
+        remaining = length
+        while remaining:
+            chunk = await wreader.read(min(remaining, 1 << 16))
+            if not chunk:
+                raise asyncio.IncompleteReadError(b"", remaining)
+            if sink.tee is not None:
+                sink.tee.append(chunk)
+            sink.writer.write(chunk)
+            await sink.writer.drain()
+            remaining -= len(chunk)
+        return status, ctype, relay, None
+
+    def _commit_relay_head(
+        self, writer, status: int, ctype: str, relay, length: int,
+        cache_key, req_tier: str, rid,
+    ) -> Optional[List[bytes]]:
+        """Write the relayed response head to the client (same bytes
+        ``_respond`` would have produced) and decide the tee: a chunk
+        list when the router cache will store this body, else None."""
+        extra = relay
+        if cache_key is not None and not any(
+                n == "X-Cache" for n, _ in extra):
+            # Router cache enabled but this answer came from a worker
+            # (and the worker didn't stamp its own cache state): stamp
+            # the router-level miss.
+            extra = extra + (("X-Cache", "miss"),)
+        if not any(n == "X-Request-Id" for n, _ in extra):
+            extra = extra + rid
+        head = (
+            f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {length}\r\n"
+        )
+        for name, value in extra:
+            head += f"{name}: {value}\r\n"
+        writer.write(head.encode("latin-1") + b"\r\n")
+        if cache_key is not None and status == 200:
+            served = next(
+                (v for n, v in relay if n == "X-Tier-Served"), None
+            )
+            if served is not None and served.strip().lower() == req_tier:
+                return []
+        return None
 
     async def _enhance(self, path, headers, body, writer, req_id) -> bool:
         rid = (("X-Request-Id", req_id),)
@@ -1413,10 +1611,10 @@ class FleetRouter:
             except ValueError:
                 budget_ms = None  # forwarded anyway; the worker 400s it
         t0 = time.monotonic()
+        req_tier = headers.get("x-tier", "quality").strip().lower()
         cache_key = None
         if self.response_cache is not None:
-            tier = headers.get("x-tier", "quality").strip().lower()
-            cache_key = self.response_cache.key(body, tier)
+            cache_key = self.response_cache.key(body, req_tier)
             cached = self.response_cache.get(cache_key)
             if cached is not None:
                 # Replay the stored worker answer without touching a
@@ -1445,53 +1643,56 @@ class FleetRouter:
                     break
                 with self._lock:
                     w.inflight += 1
+                sink = _ClientSink(
+                    writer,
+                    lambda s, c, r, n: self._commit_relay_head(
+                        writer, s, c, r, n, cache_key, req_tier, rid
+                    ),
+                )
                 try:
                     answer = await self._relay_enhance(
-                        w, path, headers, body, req_id
+                        w, path, headers, body, req_id, sink=sink
                     )
                 finally:
                     with self._lock:
                         w.inflight -= 1
                 if answer is None:
-                    # Demonstrable transport failure: the worker died or
-                    # wedged under this relay. Bounded re-dispatch, same
+                    if sink.committed:
+                        # The head (and possibly part of the body) is
+                        # already on the wire: a redispatch would splice
+                        # two answers. Account the torn relay and drop
+                        # the connection — the client sees truncation,
+                        # exactly what a direct worker death looks like.
+                        self._windows.observe(
+                            500, (time.monotonic() - t0) * 1e3
+                        )
+                        self._account_relay(w, 500)
+                        return False
+                    # Demonstrable transport failure before any byte
+                    # reached the client: the worker died or wedged
+                    # under this relay. Bounded re-dispatch, same
                     # X-Request-Id — byte-identical by replica invariance.
                     tried.add(w.slot)
                     with self._lock:
                         self._redispatches += 1
                     continue
-                status, ctype, relay, resp_body = answer
+                status, _ctype, relay, _streamed = answer
                 latency_ms = (time.monotonic() - t0) * 1e3
                 self._windows.observe(status, latency_ms)
                 self._account_relay(w, status)
-                if cache_key is not None and status == 200:
-                    served = next(
-                        (v for n, v in relay if n == "X-Tier-Served"), None
+                if sink.tee is not None:
+                    # The head callback teed the body for the router
+                    # cache (200, exact requested tier — a brown-out
+                    # downgrade is never replayed later).
+                    stored_relay = tuple(
+                        (n, v) for n, v in relay
+                        if n not in ("X-Request-Id", "X-Cache")
                     )
-                    # Same policy as the worker cache: only answers
-                    # served at the exact requested tier are stored, so
-                    # a brown-out downgrade is never replayed later.
-                    if served is not None and served.strip().lower() == \
-                            headers.get("x-tier", "quality").strip().lower():
-                        stored_relay = tuple(
-                            (n, v) for n, v in relay
-                            if n not in ("X-Request-Id", "X-Cache")
-                        )
-                        self.response_cache.put(
-                            cache_key, (ctype, stored_relay, resp_body)
-                        )
-                extra = relay
-                if cache_key is not None and not any(
-                        n == "X-Cache" for n, _ in extra):
-                    # Router cache enabled but this answer came from a
-                    # worker (and the worker didn't stamp its own cache
-                    # state): stamp the router-level miss.
-                    extra = extra + (("X-Cache", "miss"),)
-                if not any(n == "X-Request-Id" for n, _ in extra):
-                    extra = extra + rid
-                return self._respond(
-                    writer, status, resp_body, ctype=ctype, extra=extra,
-                )
+                    self.response_cache.put(
+                        cache_key,
+                        (_ctype, stored_relay, b"".join(sink.tee)),
+                    )
+                return True
             # Out of candidates (or retries): the router answers, id
             # echoed, so the client's correlation never dangles.
             self._windows.observe(504 if skipped_any else 503, 0.0)
@@ -1710,6 +1911,16 @@ def render_fleet_prometheus(summary: dict) -> str:
         metric("waternet_fleet_response_cache_entries", "gauge",
                "Router cache entries currently held",
                [(None, cache["entries"])])
+    forecast = fleet.get("forecast") or {}
+    if forecast.get("depth") is not None:
+        metric("waternet_fleet_forecast_depth", "gauge",
+               "Forecast aggregate queue depth at the scaling horizon",
+               [(None, forecast["depth"])])
+        metric("waternet_fleet_forecast_breach_eta_sec", "gauge",
+               "Seconds until the queue-depth forecast breaches the "
+               "latency objective (absent: no breach on the horizon)",
+               [(None, forecast["breach_eta_sec"])]
+               if forecast.get("breach_eta_sec") is not None else [])
     metric(
         "waternet_fleet_worker_relay_total", "counter",
         "Relayed answers per worker, by outcome",
@@ -1846,6 +2057,27 @@ def parse_args(argv=None):
         help="Minimum spacing between scale actions (anti-flap).",
     )
     parser.add_argument(
+        "--no-forecast", action="store_true",
+        help="Disable the queue-depth forecaster (on by default when "
+        "the --slo spec has a latency objective): predictive "
+        "scale-up/down composing with the burn loop.",
+    )
+    parser.add_argument(
+        "--forecast-horizon-sec", type=float, default=30.0,
+        help="Scale up when the forecast queue depth breaches the "
+        "latency objective within this many seconds.",
+    )
+    parser.add_argument(
+        "--forecast-up-sustain", type=int, default=2,
+        help="Consecutive breach-forecast ticks before a predictive "
+        "scale-up (hysteresis).",
+    )
+    parser.add_argument(
+        "--forecast-down-sustain", type=int, default=6,
+        help="Consecutive low-forecast ticks before a predictive "
+        "scale-down (hysteresis).",
+    )
+    parser.add_argument(
         "--brownout-watermark", type=int, default=1,
         help="Downgrade watermark POSTed to every worker while paging: "
         "1 = every opted-in quality request downgrades under any load.",
@@ -1903,6 +2135,10 @@ def main(argv=None) -> int:
         slo_long_sec=args.slo_long_sec,
         slo_hold_sec=args.slo_hold_sec,
         scale_cooldown_sec=args.scale_cooldown_sec,
+        forecast=not args.no_forecast,
+        forecast_horizon_sec=args.forecast_horizon_sec,
+        forecast_up_sustain=args.forecast_up_sustain,
+        forecast_down_sustain=args.forecast_down_sustain,
         brownout_watermark=args.brownout_watermark,
         heartbeat_root=args.heartbeat_dir,
         worker_faults=_parse_worker_faults(args.worker_faults),

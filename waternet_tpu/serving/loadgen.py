@@ -24,6 +24,16 @@ docs/SERVING.md "Fault isolation") — drive opt-in traffic with
 (http.client + threads); worker threads carry the pipeline
 ``THREAD_PREFIX`` so the test suite's leak guard covers them.
 
+``--arrival-rate R`` switches request mode to **open-loop Poisson**
+arrivals: request ``i`` is launched at a pre-drawn schedule time
+(seeded exponential inter-arrival gaps at R req/s) regardless of what
+came back — the regime where adaptive coalescing earns its keep,
+because a closed-loop generator's arrival rate collapses to the
+server's service rate and never exercises a queue-empty wait.
+``--rate-ramp "0:20,10:200,20:20"`` drives a piecewise-constant rate
+profile (seconds:rate pairs) for surge/decay drills; ``concurrency``
+then bounds only the in-flight parallelism, not the offered rate.
+
 ``--stream`` switches to :func:`run_stream_load`: N paced concurrent
 ``POST /stream`` sessions (open-loop — live cameras do not slow down for
 a busy server) with per-frame latency / drop / downgrade accounting and
@@ -43,11 +53,12 @@ from __future__ import annotations
 import argparse
 import itertools
 import json
+import random
 import struct
 import sys
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlparse
 
 from waternet_tpu.data.pipeline import THREAD_PREFIX
@@ -92,6 +103,67 @@ def _window_block(
     }
 
 
+def parse_rate_ramp(spec: str) -> List[Tuple[float, float]]:
+    """``"0:20,10:200,20:20"`` -> ``[(0.0, 20.0), (10.0, 200.0),
+    (20.0, 20.0)]``: piecewise-constant offered rate, each pair giving
+    the req/s that holds from that second onward. Segments must start
+    at 0 and be strictly increasing in time; every rate must be > 0."""
+    segments: List[Tuple[float, float]] = []
+    for raw in spec.split(","):
+        clause = raw.strip()
+        if not clause:
+            continue
+        t_s, sep, r_s = clause.partition(":")
+        if not sep:
+            raise ValueError(
+                f"rate ramp wants SEC:RATE pairs, got {clause!r}"
+            )
+        t, r = float(t_s), float(r_s)
+        if r <= 0:
+            raise ValueError(f"ramp rate must be > 0, got {clause!r}")
+        if segments and t <= segments[-1][0]:
+            raise ValueError(
+                f"ramp times must be strictly increasing at {clause!r}"
+            )
+        segments.append((t, r))
+    if not segments or segments[0][0] != 0.0:
+        raise ValueError(f"rate ramp must start at second 0: {spec!r}")
+    return segments
+
+
+def arrival_schedule(
+    total: int,
+    arrival_rate: Optional[float] = None,
+    rate_ramp: Optional[List[Tuple[float, float]]] = None,
+    seed: int = 0,
+) -> List[float]:
+    """Pre-drawn open-loop Poisson send times (seconds from run start).
+
+    Exponential inter-arrival gaps from a seeded PRNG, so the same
+    (total, rate, seed) always offers the same trace — a bench A/B run
+    (fixed vs adaptive coalescing) sees literally identical arrivals.
+    With ``rate_ramp``, the gap after time ``t`` is drawn at the
+    segment rate active at ``t`` (piecewise-constant intensity).
+    """
+    if (arrival_rate is None) == (rate_ramp is None):
+        raise ValueError("need exactly one of arrival_rate / rate_ramp")
+    if arrival_rate is not None:
+        if arrival_rate <= 0:
+            raise ValueError(f"arrival_rate must be > 0, got {arrival_rate}")
+        rate_ramp = [(0.0, float(arrival_rate))]
+    rng = random.Random(seed)
+    times: List[float] = []
+    t = 0.0
+    for _ in range(int(total)):
+        rate = rate_ramp[0][1]
+        for t_seg, r_seg in rate_ramp:
+            if t >= t_seg:
+                rate = r_seg
+        t += rng.expovariate(rate)
+        times.append(t)
+    return times
+
+
 def run_load(
     url: str,
     payloads: List[bytes],
@@ -106,9 +178,21 @@ def run_load(
     window_sec: float = DEFAULT_WINDOW_SEC,
     collect_ledger: bool = False,
     per_worker: bool = False,
+    arrival_rate: Optional[float] = None,
+    rate_ramp: Optional[List[Tuple[float, float]]] = None,
+    arrival_seed: int = 0,
 ) -> Dict:
     """Drive ``total`` POSTs at ``path`` with ``concurrency`` closed-loop
     workers cycling through ``payloads``; returns the accounting report.
+
+    ``arrival_rate`` (req/s) or ``rate_ramp`` (see
+    :func:`parse_rate_ramp`) switches to open-loop Poisson arrivals:
+    request ``i`` launches at a pre-drawn schedule time (seeded by
+    ``arrival_seed``, see :func:`arrival_schedule`) independent of
+    responses, and ``concurrency`` bounds only the in-flight
+    parallelism. The report then carries an ``offered`` block with the
+    schedule's realized span and any launch lag (a worker pool too
+    small to keep up shows up as lag, not as a silently slower rate).
 
     ``keep_bodies=True`` additionally returns ``bodies`` — a list of
     ``(request_index, status, body_bytes)`` — so byte-identity tests can
@@ -145,11 +229,18 @@ def run_load(
     u = urlparse(url)
     host, port = u.hostname, u.port or 80
     run_tag = new_request_id()[:8]
+    sched: Optional[List[float]] = None
+    if arrival_rate is not None or rate_ramp is not None:
+        sched = arrival_schedule(
+            total, arrival_rate=arrival_rate, rate_ramp=rate_ramp,
+            seed=arrival_seed,
+        )
     lock = threading.Lock()
     counts = {
         "ok": 0, "shed": 0, "deadline_expired": 0, "rejected": 0,
         "conn_reset": 0, "errors": 0, "downgraded": 0, "cache_hits": 0,
     }
+    launch_lag = [0.0]  # worst (actual - scheduled) launch time, open-loop
     latencies: List[float] = []
     samples: List = []  # (t_done - t0, latency_sec) for ok requests
     ledger_entries: List[Dict] = []
@@ -202,6 +293,16 @@ def run_load(
                     i = next(indices)
                 if i >= total:
                     break
+                if sched is not None:
+                    # Open-loop: fire at the pre-drawn Poisson time, not
+                    # when the last answer lands. A starved worker pool
+                    # fires late; the worst lag is reported, never hidden.
+                    lag = t_run0 + sched[i] - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    else:
+                        with lock:
+                            launch_lag[0] = max(launch_lag[0], -lag)
                 payload = payloads[i % len(payloads)]
                 rid = f"lg-{run_tag}-{i:05d}"
                 headers = {
@@ -328,6 +429,14 @@ def run_load(
         "request_id_prefix": f"lg-{run_tag}",
         "failures": failures,
     }
+    if sched is not None:
+        report["offered"] = {
+            "mode": "poisson",
+            "rate": arrival_rate,
+            "ramp": rate_ramp,
+            "span_sec": round(sched[-1], 3) if sched else 0.0,
+            "max_launch_lag_ms": round(launch_lag[0] * 1e3, 3),
+        }
     if truncated[0]:
         report["failures_truncated"] = truncated[0]
     if keep_bodies:
@@ -735,6 +844,24 @@ def main(argv=None) -> int:
     parser.add_argument("--requests", type=int, default=64)
     parser.add_argument("--deadline-ms", type=float, default=None)
     parser.add_argument(
+        "--arrival-rate", type=float, default=None, metavar="RPS",
+        help="Open-loop Poisson arrivals at this rate (req/s) instead "
+        "of closed-loop pacing: requests fire on a seeded exponential "
+        "schedule regardless of responses; --concurrency then bounds "
+        "only in-flight parallelism (request mode only).",
+    )
+    parser.add_argument(
+        "--rate-ramp", type=str, default=None, metavar="SEC:RPS,...",
+        help="Piecewise-constant open-loop rate profile, e.g. "
+        "'0:20,10:200,20:20' — 20 req/s, surge to 200 at t=10 s, back "
+        "at t=20 s (request mode only; excludes --arrival-rate).",
+    )
+    parser.add_argument(
+        "--arrival-seed", type=int, default=0,
+        help="PRNG seed for the Poisson schedule (same seed + rate = "
+        "identical offered trace, for A/B runs).",
+    )
+    parser.add_argument(
         "--window-sec", type=float, default=DEFAULT_WINDOW_SEC,
         help="Trailing span of the report's 'window' block "
         "(throughput + p50/p99 over only the last N seconds of "
@@ -827,6 +954,10 @@ def main(argv=None) -> int:
         "(X-Stream-Reuse-Warp: 1) for slow pans.",
     )
     args = parser.parse_args(argv)
+    if args.arrival_rate is not None and args.rate_ramp is not None:
+        print("--arrival-rate and --rate-ramp are exclusive",
+              file=sys.stderr)
+        return 2
 
     if args.source:
         from pathlib import Path
@@ -876,6 +1007,12 @@ def main(argv=None) -> int:
         window_sec=args.window_sec,
         collect_ledger=args.ledger is not None,
         per_worker=args.per_worker,
+        arrival_rate=args.arrival_rate,
+        rate_ramp=(
+            parse_rate_ramp(args.rate_ramp)
+            if args.rate_ramp is not None else None
+        ),
+        arrival_seed=args.arrival_seed,
     )
     if args.ledger is not None:
         from pathlib import Path

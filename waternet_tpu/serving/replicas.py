@@ -666,6 +666,20 @@ class ReplicaPool:
         with self._lock:
             return {r.index: r.state for r in self._replicas}
 
+    def has_idle_replica(self) -> bool:
+        """True when some available replica has nothing dispatched and
+        nothing in flight — i.e. a batch flushed right now would start
+        computing immediately instead of queueing behind earlier
+        batches. The adaptive dispatcher's work-conserving hold reads
+        this (serving/batcher.py): while it is False, flushing a partial
+        bucket early cannot improve latency, it only locks in a
+        slot-padded partial batch."""
+        with self._lock:
+            return any(
+                r.state in AVAILABLE_STATES and r.outstanding == 0
+                for r in self._replicas
+            )
+
     # -- dispatch ------------------------------------------------------
 
     def _pick_replica(self, bucket, exclude=None) -> _Replica:

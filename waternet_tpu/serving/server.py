@@ -83,8 +83,13 @@ interchangeable via ``inference.py --serve-url``); ``POST /stream``
 The HTTP layer is deliberately hand-rolled on ``asyncio.start_server``
 (persistent connections, Content-Length bodies): the container bakes no
 HTTP framework, and the protocol surface a batcher front door needs is
-four routes. Request decode / response encode run in the loop's default
-executor so the event loop never blocks on cv2.
+four routes. Request decode runs in the loop's default executor and
+response encode in a sized ``--encode-threads`` pool with per-thread
+reusable staging buffers (the copy-lean response path), so the event
+loop never blocks on cv2 and encode bursts never starve control work.
+``--coalesce`` picks the batching window policy (adaptive by default;
+docs/SERVING.md "Adaptive scheduling") and ``--png-level`` trades
+response-encode CPU for bytes.
 """
 
 from __future__ import annotations
@@ -97,6 +102,7 @@ import signal
 import sys
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
 import numpy as np
@@ -198,13 +204,37 @@ def _decode_request_image(body: bytes):
     return cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
 
 
-def _encode_response_png(rgb: np.ndarray) -> bytes:
+# Reusable per-thread BGR staging canvas for the encode path (the
+# copy-lean response path, docs/SERVING.md "Adaptive scheduling"):
+# cvtColor writes into a thread-local dst instead of allocating a fresh
+# canvas per response, so a sized encode pool settles on one buffer per
+# thread per shape. threading.local IS the guard — no cross-thread
+# sharing exists, so no lock (and no guarded-by) is needed.
+_ENCODE_TL = threading.local()
+
+
+def _encode_response_png(
+    rgb: np.ndarray, png_level: Optional[int] = None
+) -> bytes:
     """Enhanced RGB -> PNG bytes in file orientation (BGR), the inverse
     of :func:`_decode_request_image` — a client that imdecodes + imwrites
-    the response produces byte-identical files to local serving."""
+    the response produces byte-identical files to local serving.
+
+    ``png_level`` (0-9) maps to ``IMWRITE_PNG_COMPRESSION``; None (the
+    default) omits the parameter entirely, so the output stays
+    byte-identical to every release before the knob existed."""
     import cv2
 
-    ok, buf = cv2.imencode(".png", cv2.cvtColor(rgb, cv2.COLOR_RGB2BGR))
+    bgr = getattr(_ENCODE_TL, "bgr", None)
+    if bgr is None or bgr.shape != rgb.shape:
+        bgr = np.empty_like(rgb)
+        _ENCODE_TL.bgr = bgr
+    cv2.cvtColor(rgb, cv2.COLOR_RGB2BGR, dst=bgr)
+    params = (
+        [] if png_level is None
+        else [int(cv2.IMWRITE_PNG_COMPRESSION), int(png_level)]
+    )
+    ok, buf = cv2.imencode(".png", bgr, params)
     if not ok:
         raise RuntimeError("PNG encode failed")
     return buf.tobytes()
@@ -247,7 +277,18 @@ class ServingServer:
         stream_max_reuse_run: int = DEFAULT_MAX_REUSE_RUN,
         response_cache: int = 0,
         obs_loop_lag: bool = False,
+        coalesce: str = "fixed",
+        png_level: Optional[int] = None,
+        encode_threads: int = 2,
     ):
+        if png_level is not None and not (0 <= int(png_level) <= 9):
+            raise ValueError(
+                f"png_level must be in [0, 9] (zlib levels), got {png_level}"
+            )
+        if encode_threads < 1:
+            raise ValueError(
+                f"encode_threads must be >= 1, got {encode_threads}"
+            )
         if admit_watermark is None:
             # Shed before QueueFull would fire: the watermark is the soft
             # limit with headroom for requests already racing past it.
@@ -264,6 +305,16 @@ class ServingServer:
         self.port = int(port)
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
+        # Coalescing mode (docs/SERVING.md "Adaptive scheduling"):
+        # "fixed" holds every partial batch for max_wait_ms (the
+        # constructor default — the historical behavior); "adaptive"
+        # treats max_wait_ms as a CAP and sizes the effective window
+        # from the live arrival rate (the CLI default). Validated by
+        # the batcher's CoalesceController at warmup.
+        self.coalesce = str(coalesce)
+        self.png_level = None if png_level is None else int(png_level)
+        self.encode_threads = int(encode_threads)
+        self._encode_pool = None  # built in _main, closed in its finally
         self.replicas = replicas
         self.max_queue = int(max_queue)
         self.admit_watermark = int(admit_watermark)
@@ -464,8 +515,18 @@ class ServingServer:
                     fast_engine=self.fast_engine,
                     supervision=self.supervision,
                     downgrade_watermark=self.downgrade_watermark,
+                    coalesce=self.coalesce,
                 )
 
+            # Sized encode pool (the copy-lean response path): response
+            # PNG encodes get their OWN bounded pool instead of the
+            # loop's shared default executor, so a burst of encodes can
+            # never starve decode / reload / heartbeat work — and each
+            # pool thread settles on one reusable BGR staging buffer.
+            self._encode_pool = ThreadPoolExecutor(
+                max_workers=self.encode_threads,
+                thread_name_prefix=f"{THREAD_PREFIX}-serve-encode",
+            )
             loop = asyncio.get_running_loop()
             self.batcher = await loop.run_in_executor(None, _build_batcher)
             self.streams = StreamManager(
@@ -475,7 +536,7 @@ class ServingServer:
                 window=self.stream_window,
                 admit_watermark=self.admit_watermark,
                 decode=_decode_request_image,
-                encode=_encode_response_png,
+                encode=self._encode_png,
                 draining=self.draining,
             )
             self.ready.set()
@@ -484,7 +545,8 @@ class ServingServer:
                 f"{self.batcher.n_replicas} replicas x "
                 f"{len(self.batcher.tiers)} tiers "
                 f"[{', '.join(self.batcher.tiers)}] warmed, batch "
-                f"{self.batcher.max_batch})",
+                f"{self.batcher.max_batch}, coalesce "
+                f"{self.batcher.coalesce_mode} cap {self.max_wait_ms:g} ms)",
                 flush=True,
             )
 
@@ -523,6 +585,8 @@ class ServingServer:
         finally:
             if self._loop_tracer is not None:
                 self._loop_tracer.uninstall()
+            if self._encode_pool is not None:
+                self._encode_pool.shutdown(wait=True)
             if beat_task is not None:
                 beat_task.cancel()
             if server is not None:
@@ -535,6 +599,24 @@ class ServingServer:
             # Stats flush: the drain contract — the run's numbers survive
             # the process, in the same JSON block the CLI prints.
             print(self.stats.to_json(), flush=True)
+
+    def _encode_png(self, rgb: np.ndarray) -> bytes:
+        """The server's configured encode: :func:`_encode_response_png`
+        at this server's ``--png-level`` (None = cv2's default, byte-
+        identical to pre-knob releases)."""
+        return _encode_response_png(rgb, self.png_level)
+
+    def _config_block(self) -> dict:
+        """The ``config`` block of /stats: the scheduling knobs an
+        operator needs to interpret the gauges next to them
+        (docs/SERVING.md "Adaptive scheduling")."""
+        return {
+            "coalesce": self.coalesce,
+            "max_wait_ms": self.max_wait_ms,
+            "max_batch": self.max_batch,
+            "png_level": self.png_level,
+            "encode_threads": self.encode_threads,
+        }
 
     # -- HTTP plumbing -------------------------------------------------
 
@@ -645,8 +727,13 @@ class ServingServer:
         if path == "/healthz":
             return self._healthz(writer) and not want_close
         if path == "/stats":
+            # The summary plus the server's config block: gauges like
+            # eff_wait_ms only mean something next to the mode and cap
+            # that produced them.
+            payload = self.stats.summary()
+            payload["config"] = self._config_block()
             return (
-                self._json(writer, 200, self.stats.summary())
+                self._json(writer, 200, payload)
                 and not want_close
             )
         if path == "/metrics":
@@ -961,7 +1048,9 @@ class ServingServer:
                     500, {"error": f"{type(err).__name__}: {err}"}
                 )
             t_enc0 = time.perf_counter() if trace.enabled() else None
-            png = await loop.run_in_executor(None, _encode_response_png, out)
+            png = await loop.run_in_executor(
+                self._encode_pool, self._encode_png, out
+            )
             served = getattr(fut, "tier", tier)
             cache_extra = ()
             if cache_key is not None:
@@ -1246,8 +1335,36 @@ def parse_args(argv=None):
     )
     parser.add_argument(
         "--max-wait-ms", type=float, default=10.0,
-        help="Coalescing window: flush a partial batch once its oldest "
-        "request waited this long (per-request deadlines clamp it).",
+        help="Coalescing CAP, not a constant hold: the longest a partial "
+        "batch may wait for batchmates. Under --coalesce adaptive (the "
+        "default) the EFFECTIVE window moves inside [0, cap] with the "
+        "live arrival rate; --coalesce fixed holds every partial batch "
+        "for exactly the cap. Per-request deadlines clamp the effective "
+        "window either way.",
+    )
+    parser.add_argument(
+        "--coalesce", type=str, default="adaptive",
+        choices=["adaptive", "fixed"],
+        help="Coalescing-window policy (docs/SERVING.md 'Adaptive "
+        "scheduling'): 'adaptive' sizes each (tier, bucket)'s window "
+        "from its EWMA arrival rate — an empty-queue request flushes "
+        "immediately (p50 drops by ~the cap) and the window grows "
+        "toward --max-wait-ms as load rises; 'fixed' is the historical "
+        "constant hold. Responses are byte-identical across modes.",
+    )
+    parser.add_argument(
+        "--png-level", type=int, default=None, metavar="0-9",
+        help="PNG compression level for /enhance responses "
+        "(IMWRITE_PNG_COMPRESSION; lower = faster encode, larger "
+        "bytes). Unset keeps cv2's default — byte-identical responses "
+        "to servers without the knob.",
+    )
+    parser.add_argument(
+        "--encode-threads", type=int, default=2,
+        help="Response-encode pool size: PNG encodes run on their own "
+        "bounded pool (with per-thread reusable staging buffers) "
+        "instead of the loop's shared default executor, so encode "
+        "bursts cannot starve decode or control work.",
     )
     parser.add_argument(
         "--serve-replicas", type=str, default="auto",
@@ -1446,6 +1563,9 @@ def main(argv=None) -> int:
         stream_max_reuse_run=args.stream_max_reuse_run,
         response_cache=args.response_cache,
         obs_loop_lag=args.obs_loop_lag,
+        coalesce=args.coalesce,
+        png_level=args.png_level,
+        encode_threads=args.encode_threads,
     )
     return server.run(install_signal_handlers=True)
 

@@ -219,6 +219,13 @@ class ServingStats:
         #: Left None, the summary reports {} — bare stats objects have no
         #: replica pool to report on.
         self.replica_health_probe = None
+        #: Live effective-coalescing-window gauge: a zero-arg callable
+        #: returning {tier: eff_wait_ms} (DynamicBatcher.eff_wait_ms —
+        #: the cap under --coalesce fixed, the controller's load-aware
+        #: window under adaptive; docs/SERVING.md "Adaptive
+        #: scheduling"). Left None, the summary reports {} — bare stats
+        #: objects have no coalescing window to report.
+        self.eff_wait_probe = None
         self.retried = 0  # guarded-by: self._lock
         self.downgraded = 0  # guarded-by: self._lock
         self.nan_outputs = 0  # guarded-by: self._lock
@@ -582,6 +589,7 @@ class ServingStats:
             expired = self.deadline_expired
             probe = self.queue_depth_probe
             health_probe = self.replica_health_probe
+            eff_wait_probe = self.eff_wait_probe
             retried = self.retried
             downgraded = self.downgraded
             nan_outputs = self.nan_outputs
@@ -631,6 +639,9 @@ class ServingStats:
                 health_probe() if health_probe is not None else {}
             ),
             "queue_depth": int(probe()) if probe is not None else 0,
+            "eff_wait_ms": (
+                eff_wait_probe() if eff_wait_probe is not None else {}
+            ),
             "queue_depth_mean": round(depth_mean, 2),
             "queue_depth_max": depth_max,
             "replicas": replicas,
