@@ -97,6 +97,17 @@ answer against an unfaulted control fleet, and an exact per-worker
 reconciliation of the client's ``X-Worker-Id`` ledger against the
 router's relay ledger (``accounted``).
 
+``--config train_fullres`` measures the compressed device-cache at the
+never-trained 256x256 full-res config (waternet_tpu/data/codec.py,
+docs/PIPELINE.md "Cache codecs"): a raw-vs-dct8 codec A/B where the raw
+arm runs only if the preflight budgeter says the raw cache fits the
+live HBM headroom (cap it artificially with
+WATERNET_CACHE_HEADROOM_BYTES to exercise the refusal path) —
+``train_fullres_devcache_images_per_sec`` is the dct8 arm's fused
+gather+decode+train throughput, with ``hbm_cache_bytes``,
+``cache_compression_ratio``, the decoded-pixel ``decoded_psnr_db``, and
+the raw arm's verdict/number.
+
 ``--config tiers`` measures the per-request quality-tier A/B
 (docs/SERVING.md "Quality tiers"): one tier-routing batcher serves the
 same mixed-resolution stream through the full WaterNet pipeline and then
@@ -1379,6 +1390,101 @@ def bench_train_chaos(
             shutil.rmtree(job, ignore_errors=True)
 
 
+def bench_train_fullres(hw=None, batch=None):
+    """Full-res compressed-device-cache A/B (ROADMAP item 5's data side,
+    waternet_tpu/data/codec.py): ``--device-cache`` training at the
+    never-trained 256x256 BASELINE config, raw codec vs dct8.
+
+    The raw arm runs ONLY when the preflight budgeter says the raw cache
+    (plus its precache tables) fits the live HBM headroom — cap it with
+    WATERNET_CACHE_HEADROOM_BYTES to exercise the refusal path (the CPU
+    smoke test pins exactly that: raw refused, dct8 trains end-to-end).
+    The contract line ``train_fullres_devcache_images_per_sec`` is the
+    dct8 arm's throughput with the in-step gather + dequant/IDCT decode
+    fused ahead of the preprocess (both arms resolve through
+    trainer.cached_train_step, so each measures the exact program
+    ``--device-cache --cache-codec <name>`` trains). Also reported:
+    ``hbm_cache_bytes`` (resident encoded planes),
+    ``cache_compression_ratio`` (exactly 4.0 for dct8),
+    ``decoded_psnr_db`` on this dataset's frames, and the raw arm's
+    verdict + number when it ran.
+
+    Knobs: WATERNET_BENCH_FULLRES_HW (default 256),
+    WATERNET_BENCH_FULLRES_BATCH (default min(BATCH, 8)),
+    WATERNET_BENCH_FULLRES_PERCEPTUAL=0 drops the VGG term (CPU smoke).
+    """
+    from waternet_tpu.data import codec as cachecodec
+    from waternet_tpu.data.synthetic import SyntheticPairs
+
+    hw = _env_int("WATERNET_BENCH_FULLRES_HW", 256) if hw is None else hw
+    batch = (
+        _env_int("WATERNET_BENCH_FULLRES_BATCH", min(BATCH, 8))
+        if batch is None
+        else batch
+    )
+    n_items = 2 * batch  # measure_train's synthetic dataset size
+    overrides = {}
+    if _env_int("WATERNET_BENCH_FULLRES_PERCEPTUAL", 1) == 0:
+        overrides["perceptual_weight"] = 0.0
+
+    headroom = cachecodec.resolve_headroom()
+    rows = cachecodec.budget_report(
+        n_items, hw, hw, headroom=headroom, precache_histeq=True
+    )
+    by_codec = {r["codec"]: r for r in rows}
+
+    raw_line = None
+    raw_refused = None
+    if by_codec["raw"]["fits"] is False:
+        raw_refused = (
+            f"preflight budgeter: raw cache needs "
+            f"{by_codec['raw']['cache_bytes']} bytes against "
+            f"{headroom} bytes headroom"
+        )
+    else:
+        try:
+            raw_line = measure_train(
+                device_cache=True, hw=hw, batch=batch, cache_codec="raw",
+                **overrides,
+            )
+        except cachecodec.CacheBudgetError as e:
+            raw_refused = str(e)
+
+    dct_line = measure_train(
+        device_cache=True, hw=hw, batch=batch, cache_codec="dct8",
+        **overrides,
+    )
+
+    # Decoded-pixel fidelity on the frames this A/B actually trained on.
+    data = SyntheticPairs(n_items, hw, hw, seed=0)
+    sample = np.stack(
+        [data.load_pair(i)[0] for i in range(min(n_items, 8))]
+    )
+    psnr = cachecodec.psnr_db(sample, cachecodec.roundtrip("dct8", sample))
+
+    return {
+        "metric": "train_fullres_devcache_images_per_sec",
+        "value": dct_line["value"],
+        "unit": "images/sec/chip",
+        "vs_baseline": dct_line["vs_baseline"],
+        "codec": "dct8",
+        "hbm_cache_bytes": dct_line["hbm_cache_bytes"],
+        "cache_compression_ratio": dct_line["cache_compression_ratio"],
+        "decoded_psnr_db": round(psnr, 2),
+        "step_ms": dct_line["step_ms"],
+        "mfu": dct_line["mfu"],
+        "hbm_peak_bytes": dct_line["hbm_peak_bytes"],
+        "raw_fits": by_codec["raw"]["fits"],
+        "raw_refused": raw_refused,
+        "raw_images_per_sec": raw_line["value"] if raw_line else None,
+        "headroom_bytes": headroom,
+        "n_items": n_items,
+        "batch": batch,
+        "hw": hw,
+        "precision": dct_line["precision"],
+    }
+
+
 def bench_stream(
     n_images=None, max_batch=None, max_buckets=None, base_hw=None,
     streams=None, frames=None,
@@ -2040,12 +2146,26 @@ def measure_train(
     line["clahe_interp"] = _interp_mode(hw // ty, hw // tx)
     line["srgb_transfer"] = _srgb_transfer_mode()
     if device_cache:
+        from waternet_tpu.data import codec as cachecodec
+
         line["device_cache"] = True
         line["precache_histeq"] = engine._cache_he is not None
         line["precache_vgg_ref"] = (
             getattr(engine, "_cache_vgg_ref", None) is not None
         )
         line["cache_build_sec"] = round(cache_build_s, 2)
+        # At-rest codec provenance (waternet_tpu/data/codec.py): the
+        # RESOLVED codec, the bytes actually pinned, and the pair-level
+        # compression ratio (raw uint8 vs encoded — precache tables are
+        # reported via hbm_cache_bytes, not folded into the ratio).
+        codec_name = engine.config.cache_codec
+        line["cache_codec"] = codec_name
+        line["hbm_cache_bytes"] = engine.cache_resident_bytes()
+        line["cache_compression_ratio"] = round(
+            (hw * hw * 3)
+            / cachecodec.encoded_bytes_per_image(codec_name, hw, hw),
+            2,
+        )
     else:
         # Overlapped-input-pipeline instrumentation for the host-fed line
         # (docs/PIPELINE.md): a real load->preprocess->transfer->step epoch,
@@ -2410,11 +2530,15 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--config",
-        choices=["train", "video", "serve", "serve_multi", "serve_http",
-                 "serve_adaptive", "serve_chaos", "serve_fleet",
-                 "train_chaos", "tiers", "stream", "stream_reuse", "obs"],
+        choices=["train", "train_fullres", "video", "serve", "serve_multi",
+                 "serve_http", "serve_adaptive", "serve_chaos",
+                 "serve_fleet", "train_chaos", "tiers", "stream",
+                 "stream_reuse", "obs"],
         default="train",
-        help="train (default; the one-line contract metric), video "
+        help="train (default; the one-line contract metric), "
+        "train_fullres (256x256 --device-cache codec A/B: raw-if-fits vs "
+        "dct8 with in-step decode, HBM cache bytes, compression ratio, "
+        "decoded PSNR — docs/PIPELINE.md 'Cache codecs'), video "
         "(full-res frame throughput, BASELINE config 5), serve "
         "(mixed-resolution directory inference: bucketed vs "
         "--exact-shapes A/B, docs/SERVING.md), serve_multi "
@@ -2462,6 +2586,7 @@ def main():
     # result; train and video both keep the historical train-headline fail
     # line.
     fail_metric = {
+        "train_fullres": "train_fullres_devcache_images_per_sec",
         "serve": "mixed_res_dir_images_per_sec",
         "serve_multi": "mixed_res_dir_images_per_sec_multidev",
         "serve_http": "http_images_per_sec",
@@ -2527,6 +2652,11 @@ def main():
             # Video compiles run long; its budget has its own knob so tuning
             # the train budget can't silently starve 1080p sweeps.
             timeout_s = _env_int("WATERNET_BENCH_VIDEO_TIMEOUT", max(1800, train_t))
+        elif args.config == "train_fullres":
+            # Two 256x256 compiles (raw arm + dct8 arm) when raw fits.
+            timeout_s = _env_int(
+                "WATERNET_BENCH_FULLRES_TIMEOUT", max(1800, train_t)
+            )
         else:
             timeout_s = train_t
         err = _run_benchmark_child(timeout_s)
@@ -2547,6 +2677,10 @@ def main():
     if args.config == "video":
         hw = (HW, HW * 16 // 9) if "WATERNET_BENCH_HW" in os.environ else (1080, 1920)
         print(json.dumps(bench_video(hw=hw, batch=args.batch_size, steps=MEASURE_STEPS)))
+        return
+
+    if args.config == "train_fullres":
+        print(json.dumps(bench_train_fullres()))
         return
 
     if args.config == "serve":

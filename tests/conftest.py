@@ -180,8 +180,10 @@ class CompileSentinel:
         for attr in (
             "train_step", "train_step_pre", "train_step_cached",
             "train_step_cached_pre", "train_step_cached_pre_vggref",
+            "train_step_cached_codec",
             "eval_step", "eval_step_pre", "eval_step_cached",
             "eval_step_cached_pre", "eval_step_cached_pre_vggref",
+            "eval_step_cached_codec",
         ):
             fn = getattr(engine, attr, None)
             if fn is not None and hasattr(fn, "_cache_size"):

@@ -103,13 +103,24 @@ def test_relay_listening_detects_real_listener(bench, monkeypatch):
 @pytest.mark.skipif(
     not Path("/proc/net/tcp").exists(), reason="needs Linux procfs"
 )
-def test_bench_parent_fails_fast_when_relay_down(monkeypatch):
+@pytest.mark.parametrize(
+    "cfg_args,metric",
+    [
+        ([], "uieb_train_images_per_sec_per_chip"),
+        (["--config", "train_fullres"],
+         "train_fullres_devcache_images_per_sec"),
+        (["--config", "stream"], "video_stream_fps"),
+    ],
+)
+def test_bench_parent_fails_fast_when_relay_down(cfg_args, metric):
     """With an axon-style env and no relay listening, the parent prints the
     contract JSON error line without ever touching a device — and exits
     rc 0: "no hardware today" is carried by the JSON error field, not by a
-    nonzero exit that reads as a harness failure (BENCH_r03-r05)."""
+    nonzero exit that reads as a harness failure (BENCH_r03-r05). Each
+    config fails under ITS OWN metric name so drivers never mistake a
+    dead-tunnel serving/fullres bench for a train result."""
     proc = subprocess.run(
-        [sys.executable, str(REPO / "bench.py")],
+        [sys.executable, str(REPO / "bench.py"), *cfg_args],
         env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "axon",
              "WATERNET_RELAY_PORT": "1"},  # nothing listens on port 1
         capture_output=True,
@@ -118,6 +129,7 @@ def test_bench_parent_fails_fast_when_relay_down(monkeypatch):
     )
     assert proc.returncode == 0
     line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == metric
     assert line["value"] == 0.0
     assert "relay is not listening" in line["error"]
 
@@ -472,3 +484,51 @@ def test_bench_hostfed_only_mode_cpu():
         timeout=120,
     )
     assert proc.returncode != 0
+
+
+@pytest.mark.slow  # ~10-60 s full CLI subprocess (cold compile cache
+# dominates); the budgeter/codec pins in tests/test_codec.py stay tier-1
+def test_bench_train_fullres_contract_cpu():
+    """End-to-end `--config train_fullres` smoke at CI size: the capped
+    headroom (env override) refuses the raw arm exactly like a too-big
+    full-res dataset would on hardware, the dct8 arm still runs end to
+    end, and the contract line reports the compression the codec ladder
+    promised (>= 4x) plus the refusal breadcrumb."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_TPU_GEN", None)  # non-tunnel host: no relay gate
+    env.pop("XLA_FLAGS", None)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "WATERNET_BENCH_FULLRES_HW": "32",
+            "WATERNET_BENCH_FULLRES_BATCH": "2",
+            "WATERNET_BENCH_FULLRES_PERCEPTUAL": "0",
+            "WATERNET_BENCH_STEPS": "2",
+            "WATERNET_BENCH_WARMUP": "1",
+            "WATERNET_BENCH_PRECISION": "fp32",
+            "WATERNET_BENCH_FULLRES_TIMEOUT": "550",
+            # 4 pairs at 32x32: raw + precache tables (294912 B) exceeds
+            # this, dct8 (6144 B) fits — same shape as full-res vs HBM.
+            "WATERNET_CACHE_HEADROOM_BYTES": "30000",
+        }
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--config", "train_fullres"],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(REPO),
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "train_fullres_devcache_images_per_sec"
+    assert line["value"] > 0
+    assert line["codec"] == "dct8"
+    assert line["cache_compression_ratio"] >= 4.0
+    assert line["raw_fits"] is False
+    assert "raw cache needs" in line["raw_refused"]
+    assert line["hbm_cache_bytes"] > 0
+    assert line["decoded_psnr_db"] > 25.0  # noisy synthetic frames

@@ -1,0 +1,409 @@
+"""Cache-codec layer (waternet_tpu/data/codec.py): round-trip pins,
+Pallas/lax decode bit-parity, the HBM budgeter's decision table, and the
+engine-level exactness contracts — codec-cached epochs equal host-fed
+epochs over the decoded dataset BIT-FOR-BIT (decoders emit uint8, and
+the cached dispatch reuses the host path's rng/shuffle streams), resume
+mid-epoch is bit-identical per codec, and the fused in-step decode adds
+zero mid-epoch recompiles."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from waternet_tpu.data import codec
+from waternet_tpu.data.synthetic import SyntheticPairs
+from waternet_tpu.training.trainer import TrainConfig, TrainingEngine
+
+
+def _smooth_probe(h: int = 64, w: int = 64) -> np.ndarray:
+    """A noise-free smooth batch (2, h, w, 3): the codec-quality probe.
+
+    PSNR floors are pinned on smooth content because that is what the
+    dct8 zonal mask preserves by construction; noisy content (e.g.
+    SyntheticPairs' sensor-noise term) measures the noise, not the
+    codec, and lands ~33 dB for every lossy codec.
+    """
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    chans = [
+        40 + 80 * np.sin(xx / 19.0) * np.cos(yy / 13.0) + 60,
+        90 + 70 * np.sin(xx / 29.0 + 1.0) + 20 * np.cos(yy / 17.0),
+        120 + 60 * np.cos(xx / 11.0 + 2.0) * np.sin(yy / 23.0),
+    ]
+    img = np.clip(np.stack(chans, axis=-1), 0, 255).astype(np.uint8)
+    return np.stack([img, img[::-1].copy()])
+
+
+# ---------------------------------------------------------------------------
+# Round-trip pins
+# ---------------------------------------------------------------------------
+
+
+def test_raw_roundtrip_bit_exact(sample_rgb):
+    batch = np.stack([sample_rgb, sample_rgb[::-1].copy()])
+    out = codec.roundtrip("raw", batch)
+    np.testing.assert_array_equal(out, batch)
+
+
+@pytest.mark.parametrize(
+    "name,floor_db",
+    [("yuv420", 45.0), ("dct8", 40.0)],
+)
+def test_lossy_roundtrip_psnr_floor(name, floor_db):
+    """Quality floors on smooth content: yuv420 only loses chroma detail
+    (>= 45 dB); dct8's 4x4 zonal mask at the default table holds the
+    ISSUE-pinned >= 40 dB."""
+    probe = _smooth_probe()
+    out = codec.roundtrip(name, probe)
+    assert out.dtype == np.uint8  # uint8 out is what makes parity EXACT
+    assert out.shape == probe.shape
+    got = codec.psnr_db(probe, out)
+    assert got >= floor_db, f"{name}: {got:.2f} dB < {floor_db} dB floor"
+
+
+@pytest.mark.parametrize(
+    "name,ratio", [("raw", 1.0), ("yuv420", 2.0), ("dct8", 4.0)]
+)
+def test_compression_ratio_exact_at_multiple_of_8(name, ratio):
+    """At H/W multiples of 8 the ladder ratios are EXACT: yuv420 stores
+    Y + 2 quarter-res chroma planes (6/12 bytes per 2x2), dct8 keeps
+    16 int8 of 64 coefficients per block-channel."""
+    h, w = 64, 96
+    enc = codec.encoded_bytes_per_image(name, h, w)
+    assert h * w * 3 / enc == ratio
+    # The estimator agrees with the per-image formula (pairs, no tables).
+    assert codec.estimate_cache_bytes(name, 5, h, w) == 5 * 2 * enc
+
+
+def test_encoded_bytes_odd_sizes_match_padding():
+    # 33x47: chroma planes ceil to 17x24, dct8 blocks ceil to 5x6.
+    assert codec.encoded_bytes_per_image("yuv420", 33, 47) == (
+        33 * 47 + 2 * 17 * 24
+    )
+    assert codec.encoded_bytes_per_image("dct8", 33, 47) == 5 * 6 * 3 * 16
+
+
+def test_unknown_codec_rejected_everywhere():
+    bad = "webp"
+    with pytest.raises(ValueError, match="unknown cache codec"):
+        codec.encode(bad, np.zeros((1, 8, 8, 3), np.uint8))
+    with pytest.raises(ValueError, match="unknown cache codec"):
+        codec.encoded_bytes_per_image(bad, 8, 8)
+    with pytest.raises(ValueError, match="unknown cache codec"):
+        codec.choose_codec(bad, 1, 8, 8, headroom=None)
+
+
+@pytest.mark.parametrize("hw", [(33, 47), (64, 64), (96, 128)])
+def test_dct8_pallas_lax_decode_bit_parity(hw, sample_rgb):
+    """The Pallas dequant+IDCT kernel (interpret mode off-TPU) and the
+    lax fallback run the same f32 dot_general contraction, so the uint8
+    outputs must be BIT-identical — including odd sizes where the
+    encoder edge-padded to the block grid."""
+    h, w = hw
+    img = np.asarray(sample_rgb[:h, :w])
+    if img.shape[:2] != (h, w):  # tile the fixture up for larger probes
+        reps = (-(-h // img.shape[0]), -(-w // img.shape[1]), 1)
+        img = np.tile(img, reps)[:h, :w]
+    payload = {
+        k: jax.numpy.asarray(v)
+        for k, v in codec.encode("dct8", np.stack([img, img])).items()
+    }
+    via_lax = np.asarray(codec.decode("dct8", payload, h, w, use_pallas=False))
+    via_pallas = np.asarray(
+        codec.decode("dct8", payload, h, w, use_pallas=True, interpret=True)
+    )
+    np.testing.assert_array_equal(via_lax, via_pallas)
+
+
+# ---------------------------------------------------------------------------
+# Budgeter decision table
+# ---------------------------------------------------------------------------
+
+# 8 pairs at 32x32: raw pairs 48 KiB (+240 KiB precache tables: WB/GC
+# planes + 8 dihedral CLAHE variants), yuv420 24 KiB, dct8 12 KiB.
+_N, _HW = 8, 32
+_RAW_PAIRS = 2 * _N * _HW * _HW * 3  # 49152
+_RAW_WITH_TABLES = _RAW_PAIRS + _N * (2 + 8) * _HW * _HW * 3  # 294912
+
+
+def test_budget_report_unknowable_headroom_trusts_caller():
+    rows = codec.budget_report(_N, _HW, _HW, headroom=None)
+    assert [r["codec"] for r in rows] == list(codec.CODECS)
+    assert all(r["fits"] is None for r in rows)
+    by = {r["codec"]: r for r in rows}
+    assert by["raw"]["cache_bytes"] == _RAW_PAIRS
+    assert by["yuv420"]["compression_ratio"] == 2.0
+    assert by["dct8"]["compression_ratio"] == 4.0
+    assert by["raw"]["decode_flops_per_image"] == 0
+    # auto with unknowable headroom keeps today's behaviour: raw.
+    assert codec.choose_codec("auto", _N, _HW, _HW, headroom=None)[
+        "codec"
+    ] == "raw"
+
+
+def test_choose_codec_auto_walks_the_ladder():
+    """auto picks the FIRST fitting codec (cheapest decode wins)."""
+    big = int(_RAW_WITH_TABLES / codec.HEADROOM_SAFETY) + 1
+    kw = dict(precache_histeq=True)
+    assert codec.choose_codec(
+        "auto", _N, _HW, _HW, headroom=big, **kw
+    )["codec"] == "raw"
+    # Raw (with its precache tables) over budget, yuv420 under: yuv420.
+    assert codec.choose_codec(
+        "auto", _N, _HW, _HW, headroom=60_000, **kw
+    )["codec"] == "yuv420"
+    assert codec.choose_codec(
+        "auto", _N, _HW, _HW, headroom=20_000, **kw
+    )["codec"] == "dct8"
+    with pytest.raises(codec.CacheBudgetError, match="no cache codec fits"):
+        codec.choose_codec("auto", _N, _HW, _HW, headroom=10_000, **kw)
+
+
+def test_choose_codec_named_refusal_names_the_codec_that_fits():
+    """The ride-along contract: instead of an opaque allocator OOM, a
+    sized message that names the sizes AND the codec that would fit."""
+    with pytest.raises(codec.CacheBudgetError) as exc:
+        codec.choose_codec(
+            "raw", _N, _HW, _HW, headroom=20_000, precache_histeq=True
+        )
+    msg = str(exc.value)
+    assert "'raw' does not fit" in msg
+    assert "8 pairs at 32x32" in msg
+    assert "--cache-codec dct8" in msg  # the fitting alternative, by name
+
+
+def test_resolve_headroom_env_override_and_fake_memory_stats(monkeypatch):
+    monkeypatch.setenv("WATERNET_CACHE_HEADROOM_BYTES", "12345")
+    assert codec.resolve_headroom() == 12345
+    monkeypatch.delenv("WATERNET_CACHE_HEADROOM_BYTES")
+
+    class _Dev:
+        def memory_stats(self):
+            return {"bytes_limit": 1000, "bytes_in_use": 250}
+
+    class _NoStats:
+        pass
+
+    assert codec.resolve_headroom(_Dev()) == 750
+    assert codec.resolve_headroom(_NoStats()) is None
+
+
+def test_report_lines_render_fits_column():
+    rows = codec.budget_report(
+        _N, _HW, _HW, headroom=60_000, precache_histeq=True
+    )
+    text = "\n".join(codec.report_lines(rows, 60_000))
+    for name in codec.CODECS:
+        assert name in text
+    assert "yes" in text and "NO" in text
+
+
+# ---------------------------------------------------------------------------
+# Engine-level exactness
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(**overrides):
+    kw = dict(
+        batch_size=4, im_height=32, im_width=32, precision="fp32",
+        perceptual_weight=0.0, shuffle=True,
+    )
+    kw.update(overrides)
+    return TrainConfig(**kw)
+
+
+class _DecodedPairs:
+    """SyntheticPairs seen through a host-side codec round-trip — the
+    reference the codec-cached path must match EXACTLY."""
+
+    def __init__(self, base: SyntheticPairs, name: str):
+        self._base = base
+        self._codec = name
+
+    def __len__(self):
+        return len(self._base)
+
+    def load_pair(self, idx):
+        raw, ref = self._base.load_pair(idx)
+        return (
+            codec.roundtrip(self._codec, raw[None])[0],
+            codec.roundtrip(self._codec, ref[None])[0],
+        )
+
+    def batches(self, indices, batch_size, **kwargs):
+        from waternet_tpu.data.batching import iter_batches
+
+        return iter_batches(self.load_pair, indices, batch_size, **kwargs)
+
+
+def _state_leaves(engine):
+    return [np.asarray(x) for x in jax.tree.leaves(
+        jax.device_get(engine.state)
+    )]
+
+
+@pytest.mark.parametrize(
+    "name, epochs, check_eval",
+    [
+        # Tier-1 budget contract (PR 17): one fast representative per
+        # guarantee. dct8 (the default lossy rung, and the codec the
+        # bench contract ships) pins 1-epoch train parity + state
+        # bit-identity in ~16 s; the 2-epoch cross-permutation + eval
+        # variants of both codecs ride the slow lane (~30 s each —
+        # eval adds two more jitted programs to compile).
+        pytest.param("yuv420", 2, True, marks=pytest.mark.slow),
+        pytest.param("dct8", 2, True, marks=pytest.mark.slow),
+        ("dct8", 1, False),
+    ],
+)
+def test_codec_cached_epoch_matches_host_fed_decoded(name, epochs, check_eval):
+    """EXACT parity pin (not approx): a codec-cached epoch equals a
+    host-fed epoch over the host-round-tripped dataset bit-for-bit.
+    Decoders emit uint8 and the cached dispatch folds the same
+    (seed, epoch, count) rng and Philox shuffle as the host path, so
+    the two runs see byte-identical batches in identical order."""
+    n, bs, hw = 8, 4, 32
+    cfg = _tiny_cfg(cache_codec=name)
+    ds = SyntheticPairs(n, hw, hw, seed=0)
+    idx = np.arange(n)
+
+    cached = TrainingEngine(cfg)
+    cached.cache_dataset(ds, idx)
+    host = TrainingEngine(_tiny_cfg())
+    decoded = _DecodedPairs(ds, name)
+
+    for epoch in range(epochs):
+        m_cached = cached.train_epoch_cached(epoch=epoch)
+        m_host = host.train_epoch(
+            decoded.batches(idx, bs, shuffle=True, seed=cfg.seed, epoch=epoch),
+            epoch=epoch,
+        )
+        assert m_host == m_cached, (epoch, m_host, m_cached)
+    for a, b in zip(_state_leaves(host), _state_leaves(cached)):
+        np.testing.assert_array_equal(a, b)
+    if not check_eval:
+        return
+    # Eval over the train cache decodes in-step. Approx, not exact:
+    # eval_step and eval_step_cached_codec are different XLA programs,
+    # so the metric reductions may fuse in a different order (same
+    # tolerance as test_device_cached_epoch_matches_host_fed).
+    e_cached = cached.eval_epoch_cached()
+    e_host = host.eval_epoch(decoded.batches(idx, bs, shuffle=False))
+    for k in e_host:
+        assert e_host[k] == pytest.approx(e_cached[k], rel=1e-5), k
+
+
+@pytest.mark.slow  # ~24 s: two 2-epoch cached runs; the exact-parity
+# test above already pins dct8 correctness fast
+def test_dct8_end_metrics_track_raw_within_tolerance():
+    """Lossy training lands near raw training (measured rel deltas over
+    2 epochs: loss/mse ~1.6%, psnr ~0.6%, ssim abs ~0.07 — pins leave
+    ~6x slack so codec-table tweaks fail loudly, numeric jitter not)."""
+    n, hw = 8, 32
+    ds = SyntheticPairs(n, hw, hw, seed=0)
+    idx = np.arange(n)
+    finals = {}
+    for name in ("raw", "dct8"):
+        eng = TrainingEngine(_tiny_cfg(cache_codec=name))
+        eng.cache_dataset(ds, idx)
+        for epoch in range(2):
+            finals[name] = eng.train_epoch_cached(epoch=epoch)
+    raw, lossy = finals["raw"], finals["dct8"]
+    assert lossy["loss"] == pytest.approx(raw["loss"], rel=0.10)
+    assert lossy["mse"] == pytest.approx(raw["mse"], rel=0.10)
+    assert lossy["psnr"] == pytest.approx(raw["psnr"], rel=0.05)
+    assert abs(lossy["ssim"] - raw["ssim"]) < 0.15
+
+
+@pytest.mark.slow  # ~14 s each (tier-1 budget contract, PR 17): the
+# fast representative for cached-path exactness is the dct8 epoch-parity
+# test above — resume reuses the identical pure dispatch it pins
+@pytest.mark.parametrize("name", ["raw", "yuv420", "dct8"])
+def test_codec_cache_midepoch_resume_bit_identical(name):
+    """Resume replays the tail exactly, per codec: batch 0 stepped
+    manually through cached_train_step() (the dispatch train_epoch_cached
+    resolves through), then train_epoch_cached(start_batch=1) must land
+    on the same state as the uninterrupted epoch — the dispatch is pure
+    in (seed, epoch, count) plus the cache, so this is an equality pin,
+    not a tolerance."""
+    n, hw = 8, 32
+    cfg = _tiny_cfg(cache_codec=name)
+    ds = SyntheticPairs(n, hw, hw, seed=0)
+    idx = np.arange(n)
+
+    full = TrainingEngine(cfg)
+    full.cache_dataset(ds, idx)
+    full.train_epoch_cached(epoch=0)
+
+    resumed = TrainingEngine(cfg)
+    resumed.cache_dataset(ds, idx)
+    batches = list(resumed._cached_index_batches(n, 0, cfg.shuffle))
+    base_rng = jax.random.PRNGKey(cfg.seed + 1)
+    step_fn, cache_args = resumed.cached_train_step()
+    b_idx, n_real = batches[0]
+    rng = jax.random.fold_in(jax.random.fold_in(base_rng, 0), 0)
+    resumed.state, _ = step_fn(
+        resumed.state, *cache_args, resumed._replicate_global(b_idx), rng,
+        n_real,
+    )
+    resumed.train_epoch_cached(epoch=0, start_batch=1)
+
+    for a, b in zip(_state_leaves(full), _state_leaves(resumed)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow  # ~21 s: two cached epochs + evals; rides the slow
+# lane with the devpre recompile sentinel (tier-1 budget contract,
+# PR 17) — the fast dct8 parity test above would catch a shape drift
+# too (it would break bit-identity), this names the recompile cause
+def test_codec_cache_zero_midepoch_recompiles(compile_sentinel):
+    """The fused in-step decode must not recompile after warm-up — tail
+    batches ride the n_real mask (same program), and the enc payload
+    shapes never change across epochs or into eval."""
+    n, hw = 10, 32  # 10/4 leaves a masked tail batch
+    eng = TrainingEngine(_tiny_cfg(cache_codec="dct8"))
+    eng.cache_dataset(SyntheticPairs(n, hw, hw, seed=0), np.arange(n))
+    eng.train_epoch_cached(epoch=0)  # warm-up epoch compiles by design
+    eng.eval_epoch_cached()
+    compile_sentinel.arm_engine(eng)
+    eng.train_epoch_cached(epoch=1)
+    eng.eval_epoch_cached()
+    compile_sentinel.check()
+
+
+def test_cache_dataset_budget_error_is_sized_not_oom(monkeypatch):
+    """Ride-along regression: a dataset that outgrows HBM used to die in
+    the allocator mid-build; the preflight budgeter must refuse up front
+    with the sizes and the codec that would fit."""
+    monkeypatch.setenv("WATERNET_CACHE_HEADROOM_BYTES", "20000")
+    eng = TrainingEngine(_tiny_cfg())  # raw, the default
+    ds = SyntheticPairs(_N, _HW, _HW, seed=0)
+    with pytest.raises(codec.CacheBudgetError) as exc:
+        eng.cache_dataset(ds, np.arange(_N))
+    assert "--cache-codec dct8" in str(exc.value)
+
+
+def test_cache_dataset_auto_resolves_and_reports_resident_bytes(monkeypatch):
+    """auto resolution mutates config.cache_codec before tracing, and
+    cache_resident_bytes() equals the budgeter's estimate exactly for a
+    lossy cache (no precache tables ride along)."""
+    monkeypatch.setenv("WATERNET_CACHE_HEADROOM_BYTES", "60000")
+    eng = TrainingEngine(_tiny_cfg(cache_codec="auto"))
+    ds = SyntheticPairs(_N, _HW, _HW, seed=0)
+    eng.cache_dataset(ds, np.arange(_N))
+    assert eng.config.cache_codec == "yuv420"
+    assert eng.cache_resident_bytes() == codec.estimate_cache_bytes(
+        "yuv420", _N, _HW, _HW
+    )
+
+
+def test_precache_vgg_ref_with_lossy_codec_rejected():
+    """The vgg(ref) feature table is keyed to exact reference pixels; a
+    lossy cache would silently pin features for images it never trains
+    on, so the combination is refused up front."""
+    eng = TrainingEngine(
+        _tiny_cfg(cache_codec="dct8", precache_vgg_ref=True)
+    )
+    ds = SyntheticPairs(4, 32, 32, seed=0)
+    with pytest.raises(ValueError, match="precache_vgg_ref"):
+        eng.cache_dataset(ds, np.arange(4))

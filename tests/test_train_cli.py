@@ -92,3 +92,50 @@ def test_train_cli_resume_continues_step(tmp_path, monkeypatch):
     eng.restore(d2 / "state")
     # 8 images / batch 4 = 2 steps per epoch; resumed run ends at step 4.
     assert int(eng.state.step) == 4
+
+
+def test_train_cli_cache_report_prints_table_and_skips_training(
+    run_dir, capsys
+):
+    """--cache-report is the preflight budgeter as a standalone CLI: it
+    prints the per-codec decision table for THIS dataset/size and exits
+    before compiling a model or creating a run directory."""
+    import train as cli
+
+    cli.main(ARGS + ["--cache-report"])
+    out = capsys.readouterr().out
+    assert "device-cache budget" in out
+    for name in ("raw", "yuv420", "dct8"):
+        assert name in out
+    assert not run_dir.exists()  # report only: no artifacts, no training
+
+
+def test_train_cli_cache_codec_requires_device_cache():
+    """A lossy codec without --device-cache would silently train host-fed
+    on pristine pixels — refuse the ignored flag instead."""
+    import train as cli
+
+    with pytest.raises(SystemExit, match="--device-cache"):
+        cli.main(ARGS + ["--cache-codec", "dct8", "--epochs", "1"])
+
+
+@pytest.mark.slow  # ~12 s: a full 1-epoch device-cache CLI run; the
+# cheap --cache-report/refusal pins above stay tier-1
+def test_train_cli_device_cache_codec_provenance(run_dir, capsys):
+    """A --device-cache run surfaces the resolved codec on stdout and
+    records codec + resident bytes in config.json (exactly the budgeter's
+    estimate: a lossy cache pins no precache tables)."""
+    import train as cli
+    from waternet_tpu.data import codec
+
+    cli.main(
+        ARGS + ["--epochs", "1", "--device-cache", "--cache-codec", "dct8"]
+    )
+    out = capsys.readouterr().out
+    assert "Device cache: codec=dct8" in out
+    cfg = json.loads((run_dir / "config.json").read_text())
+    # --synthetic 8 splits 7 train / 1 val (synthetic_split).
+    assert cfg["cache_codec"] == "dct8"
+    assert cfg["cache_resident_bytes"] == codec.estimate_cache_bytes(
+        "dct8", 7, 32, 32
+    )

@@ -471,6 +471,48 @@ def test_bench_history_adaptive_p50_direction(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_bench_history_train_fullres_directions(tmp_path, capsys):
+    """The full-res device-cache contract line: throughput and codec
+    quality grade higher-better; the resident cache size grades
+    lower-better (a growing cache is a regression). A throughput drop
+    with the ride-along keys steady flags exactly once."""
+    from tools import bench_history
+
+    assert bench_history.metric_direction(
+        "train_fullres_devcache_images_per_sec"
+    ) == 1
+    assert bench_history.metric_direction("cache_compression_ratio") == 1
+    assert bench_history.metric_direction("decoded_psnr_db") == 1
+    assert bench_history.metric_direction("hbm_cache_bytes") == -1
+    ride = {"cache_compression_ratio": 4.0, "decoded_psnr_db": 33.0,
+            "hbm_cache_bytes": 9.8e7}
+    _write_round(tmp_path, 1, {
+        "metric": "train_fullres_devcache_images_per_sec",
+        "value": 900.0, **ride,
+    })
+    _write_round(tmp_path, 2, {
+        "metric": "train_fullres_devcache_images_per_sec",
+        "value": 700.0, **ride,
+    })
+    assert bench_history.main(
+        ["--root", str(tmp_path), "--threshold-pct", "10"]
+    ) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSIONS" in out
+    assert out.count("->") == 1  # only the throughput drop flags
+
+    # The cache GROWING is a regression too, even with throughput flat.
+    _write_round(tmp_path, 3, {
+        "metric": "train_fullres_devcache_images_per_sec",
+        "value": 700.0, **dict(ride, hbm_cache_bytes=2.0e8),
+    })
+    assert bench_history.main(
+        ["--root", str(tmp_path), "--threshold-pct", "10"]
+    ) == 1
+    out = capsys.readouterr().out
+    assert "hbm_cache_bytes" in out.split("REGRESSIONS")[1]
+
+
 def test_bench_history_all_error_rounds_rc0(tmp_path, capsys):
     """The committed repo state today: every round is an error round
     (chip unreachable). That is a tunnel problem, not a perf regression

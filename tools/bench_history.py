@@ -20,9 +20,12 @@ snapshot, and nobody reads five of them side by side. This tool does:
 
 Direction heuristic: throughput-ish names (``per_sec``, ``mfu``,
 ``vs_baseline``, ``reduction``, ``occupancy``, ``fps`` — incl. the
-stream contract lines ``video_stream_fps`` / ``stream_reuse_fps``) are
-higher-better; cost-ish suffixes (``_ms``, ``_pct``, ``_sec``,
-``_bytes``) are lower-better; anything else is informational (never
+stream contract lines ``video_stream_fps`` / ``stream_reuse_fps`` and
+the full-res device-cache line
+``train_fullres_devcache_images_per_sec``) are higher-better; cost-ish
+suffixes (``_ms``, ``_pct``, ``_sec``, ``_bytes``) are lower-better —
+which also covers the codec line's ``hbm_cache_bytes`` (a growing
+cache is a regression); anything else is informational (never
 flagged).
 
 Pure stdlib, no jax — runnable on any host that has the checkouts.
@@ -41,7 +44,7 @@ _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 #: Metric-name fragments that mean "bigger is better".
 _HIGHER = ("per_sec", "mfu", "vs_baseline", "reduction", "occupancy",
-           "images_per", "fps")
+           "images_per", "fps", "compression_ratio", "psnr")
 #: Name suffixes that mean "smaller is better".
 _LOWER = ("_ms", "_pct", "_sec", "_bytes", "_overhead")
 

@@ -77,6 +77,8 @@ def parse_args(argv=None):
     p.add_argument("--prefetch", type=int, default=0, metavar="K",
                    help="Bounded prefetch depth of the input pipeline (batches in flight; default 0 = 2x workers)")
     p.add_argument("--device-cache", action="store_true", help="Pin the whole uint8 dataset in device memory (UIEB@112x112 ~60 MB) and gather batches on device: zero per-step host feed, bit-identical epochs (same Philox shuffle + augment streams)")
+    p.add_argument("--cache-codec", type=str, default="raw", choices=["raw", "yuv420", "dct8", "auto"], help="With --device-cache: at-rest codec for the HBM-resident dataset (waternet_tpu/data/codec.py). raw = today's uint8 path (bit-exact, 1x); yuv420 = chroma-subsampled (2x); dct8 = 8x8 zonal DCT, int8-quantized (4x, >=40 dB on smooth content) decoded inside the step; auto = preflight budgeter picks the cheapest-decode codec that fits live HBM headroom and prints the decision")
+    p.add_argument("--cache-report", action="store_true", help="Print the device-cache budget table (per-codec cache bytes, decode FLOPs/image, fit/no-fit against live memory_stats() headroom) for this dataset/size and exit without training")
     p.add_argument("--no-precache-histeq", action="store_true", help="With --device-cache: keep WB/GC/CLAHE inside the step instead of precomputing them (CLAHE per dihedral augmentation variant) at cache-build time. Precaching is default because it removes ~half the measured step time at a few hundred MB of HBM")
     p.add_argument("--precache-vgg-ref", action="store_true", help="With --device-cache: also precompute the perceptual term's VGG features of every dihedral ref variant at cache-build time (the ref branch carries no gradient), removing ~8.6%% of step FLOPs (docs/MFU.md). Default off pending hardware A/B; numerics equivalent within compute-dtype tolerance")
     p.add_argument("--no-shuffle", action="store_true", help="Reference bug-compat: no train shuffling")
@@ -134,6 +136,14 @@ def main(argv=None):
             "exclusive (device preprocessing is the default; "
             "--host-preprocess selects the cv2 host path)"
         )
+    if (
+        args.cache_codec != "raw"
+        and not args.device_cache
+        and not args.cache_report
+    ):
+        # Ignored-flag contract: a codec choice that silently does nothing
+        # would let an A/B run measure the wrong path.
+        raise SystemExit("--cache-codec requires --device-cache")
     start_ts = time.perf_counter()
     projectroot = Path(__file__).parent
 
@@ -232,6 +242,7 @@ def main(argv=None):
         spatial_shards=args.spatial_shards,
         precache_histeq=not args.no_precache_histeq,
         precache_vgg_ref=args.precache_vgg_ref,
+        cache_codec=args.cache_codec,
         distill=args.distill,
         student_width=args.student_width,
         student_depth=args.student_depth,
@@ -272,6 +283,30 @@ def main(argv=None):
 
         train_idx = _agreed(train_idx, dataset.prevalidate(train_idx))
         val_idx = _agreed(val_idx, dataset.prevalidate(val_idx))
+
+    if args.cache_report:
+        # The preflight budgeter as a standalone report: per-codec cache
+        # bytes / decode FLOPs / fit vs live headroom for THIS dataset and
+        # size, no training (and no model compilation).
+        from waternet_tpu.data import codec as cachecodec
+
+        headroom = cachecodec.resolve_headroom(jax.devices()[0])
+        rows = cachecodec.budget_report(
+            len(train_idx),
+            args.height,
+            args.width,
+            headroom=headroom,
+            precache_histeq=config.precache_histeq
+            and not config.host_preprocess,
+            precache_vgg_ref=config.precache_vgg_ref,
+            vgg_ref_bytes_per_item=(args.height // 16)
+            * (args.width // 16)
+            * 512
+            * (2 if args.precision == "bf16" else 4),
+        )
+        for line in cachecodec.report_lines(rows, headroom):
+            print(line)
+        return
 
     # --- engine ---
     params = None
@@ -351,6 +386,13 @@ def main(argv=None):
         if args.host_preprocess:
             raise SystemExit("--device-cache requires device preprocessing")
         engine.cache_dataset(dataset, train_idx)
+        # cache_dataset's preflight budgeter resolved "auto" (and sized
+        # the build); surface the decision the way bench A/Bs read it.
+        print(
+            f"Device cache: codec={engine.config.cache_codec} "
+            f"resident={engine.cache_resident_bytes()} bytes "
+            f"({len(train_idx)} pairs at {args.height}x{args.width})"
+        )
     elif args.precache_vgg_ref:
         # Same contract as cache_dataset's ValueError: an ignored A/B flag
         # must fail loudly, not silently measure the wrong path.
@@ -593,6 +635,14 @@ def main(argv=None):
                 "distill": config.distill,
                 "student_width": config.student_width if config.distill else None,
                 "student_depth": config.student_depth if config.distill else None,
+                # Device-cache provenance: the RESOLVED codec (auto ->
+                # concrete) and the bytes actually pinned in HBM.
+                "cache_codec": (
+                    config.cache_codec if args.device_cache else None
+                ),
+                "cache_resident_bytes": (
+                    engine.cache_resident_bytes() if args.device_cache else None
+                ),
             },
             f,
             indent=4,

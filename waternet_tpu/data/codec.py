@@ -1,0 +1,518 @@
+"""Cache codecs: compressed device-resident datasets, decoded in-step.
+
+``--device-cache`` pins the whole uint8 dataset in HBM and removes the
+per-step host feed — but at 256x256 full-res the raw cache (plus the
+precache_histeq tables) outgrows HBM and training falls back to the
+host-fed pipeline and its 10x-larger H2D traffic. Per *Rapid-INR*
+(PAPERS.md, arXiv:2306.16699), a compressed device-resident dataset with
+on-accelerator decode beats the CPU-fed pipeline outright. This module is
+the codec ladder:
+
+* ``raw``    — today's uint8 path. Bit-exact, 1x, zero decode FLOPs;
+  keeps the precache_histeq / precache_vgg_ref tables.
+* ``yuv420`` — BT.601 full-range YCbCr with 2x2 box-mean chroma
+  subsampling. Exactly 2.0x (even sizes; odd sizes round the chroma
+  planes up). Decode: nearest-neighbour chroma upsample + one 3x3
+  matrix per pixel.
+* ``dct8``   — 8x8 blockwise orthonormal DCT, 4x4 low-frequency zonal
+  keep, int8 quantization under :data:`DCT8_QUANT`. Exactly 4.0x
+  (multiple-of-8 sizes; others pad to blocks). Decode is ONE dense
+  ``(blocks, 16) @ (16, 64)`` matmul — the shape XLA/TPU's MXU loves —
+  with a Pallas kernel behind ``WATERNET_PALLAS=1``
+  (:func:`waternet_tpu.ops.pallas_kernels.dct8_dequant_idct`) kept
+  bit-identical to the lax fallback.
+
+Both lossy decoders emit **uint8** pixels: the in-step decode output is
+exactly the array a host would produce by round-tripping the codec
+offline, so "codec-cached epoch == host-fed epoch over the decoded
+dataset" is an EXACT pin, not a tolerance (tests/test_codec.py).
+
+The module also owns the preflight HBM budgeter: per-codec cache-byte
+estimates against live ``memory_stats()`` headroom
+(:mod:`waternet_tpu.obs.device`), the ``auto`` codec choice (cheapest
+decode that fits), the ``train.py --cache-report`` table, and the sized
+:class:`CacheBudgetError` that replaces the bare allocator death when
+nothing fits. ``WATERNET_CACHE_HEADROOM_BYTES`` overrides the live
+headroom (tests, and the bench's artificially-capped A/B arm).
+
+Host-side encoders are pure numpy; decoders are jax and meant to be
+traced inside the cached train/eval step (the trainer fuses them ahead
+of ``fused_train_preprocess``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+CODECS = ("raw", "yuv420", "dct8")
+
+#: Fraction of the reported HBM headroom the budgeter will commit to a
+#: cache — the rest stays for activations, fragmentation, and the
+#: donated-state double buffer.
+HEADROOM_SAFETY = 0.9
+
+#: dct8 zonal keep: the low-frequency ZONE x ZONE corner of each 8x8
+#: coefficient block (16 of 64 coefficients -> exactly 4.0x).
+DCT8_ZONE = 4
+
+#: Default quantization table over the kept zone, flattened row-major:
+#: ``q[u, v] = 8 + 2 * (u + v)`` — 8 on DC (bound +-1016 -> int8 exact)
+#: rising to 20 on the highest kept frequency. >= 40 dB on smooth
+#: content (pinned in tests/test_codec.py).
+DCT8_QUANT = np.array(
+    [[8.0 + 2.0 * (u + v) for v in range(DCT8_ZONE)] for u in range(DCT8_ZONE)],
+    np.float32,
+).reshape(-1)
+
+# BT.601 full-range (JPEG) RGB<->YCbCr constants.
+_YCBCR_FWD = np.array(
+    [
+        [0.299, 0.587, 0.114],
+        [-0.168736, -0.331264, 0.5],
+        [0.5, -0.418688, -0.081312],
+    ],
+    np.float32,
+)
+_YCBCR_INV = np.array(
+    [
+        [1.0, 0.0, 1.402],
+        [1.0, -0.344136, -0.714136],
+        [1.0, 1.772, 0.0],
+    ],
+    np.float32,
+)
+
+
+class CacheBudgetError(RuntimeError):
+    """Device cache would not fit in HBM — sized, actionable message.
+
+    Raised by the preflight budgeter instead of letting the allocator die
+    with a bare OOM mid-build; names the cheapest codec that WOULD fit
+    when one exists.
+    """
+
+
+def _dct_basis() -> np.ndarray:
+    """Orthonormal 8-point DCT-II basis A: ``coeff = A @ x``, ``A @ A.T = I``."""
+    k = np.arange(8, dtype=np.float64)
+    a = np.cos((2 * k[None, :] + 1) * k[:, None] * np.pi / 16.0)
+    a *= np.sqrt(2.0 / 8.0)
+    a[0] *= np.sqrt(0.5)
+    return a.astype(np.float32)
+
+
+DCT8_BASIS = _dct_basis()
+
+
+def _idct_matrix() -> np.ndarray:
+    """(16, 64) float32: kept zonal coefficients -> one 8x8 pixel block.
+
+    ``M[(u, v), (x, y)] = A[u, x] * A[v, y]`` with both pairs flattened
+    row-major; decode is ``pixels = (coeff * q) @ M``. Shared verbatim by
+    the lax and Pallas decode paths so their contraction is identical.
+    """
+    a = DCT8_BASIS.astype(np.float64)
+    m = np.einsum("ux,vy->uvxy", a[:DCT8_ZONE], a[:DCT8_ZONE])
+    return m.reshape(DCT8_ZONE * DCT8_ZONE, 64).astype(np.float32)
+
+
+DCT8_IDCT_MATRIX = _idct_matrix()
+
+
+# ---------------------------------------------------------------------------
+# Host-side encoders (numpy, cache-build time)
+# ---------------------------------------------------------------------------
+
+
+def _pad_to_multiple_np(img: np.ndarray, mult: int) -> np.ndarray:
+    """Edge-replicate H/W of (N, H, W, C) up to multiples of ``mult``."""
+    _, h, w, _ = img.shape
+    ph = (-h) % mult
+    pw = (-w) % mult
+    if ph or pw:
+        img = np.pad(img, ((0, 0), (0, ph), (0, pw), (0, 0)), mode="edge")
+    return img
+
+
+def encode(codec: str, u8: np.ndarray) -> Dict[str, np.ndarray]:
+    """(N, H, W, 3) uint8 -> codec payload dict (host numpy arrays).
+
+    The payload is a flat name->array dict so the trainer can pin each
+    plane in HBM and gather it per batch by index; decode reconstructs
+    uint8 pixels from the gathered batch on device.
+    """
+    u8 = np.asarray(u8, np.uint8)
+    if codec == "raw":
+        return {"raw": u8}
+    if codec == "yuv420":
+        return _encode_yuv420(u8)
+    if codec == "dct8":
+        return _encode_dct8(u8)
+    raise ValueError(f"unknown cache codec {codec!r} (choose from {CODECS})")
+
+
+def _encode_yuv420(u8: np.ndarray) -> Dict[str, np.ndarray]:
+    rgb = u8.astype(np.float32)
+    ycc = rgb @ _YCBCR_FWD.T
+    ycc[..., 1:] += 128.0
+    y = np.clip(np.round(ycc[..., 0]), 0, 255).astype(np.uint8)
+    # 2x2 box-mean chroma subsample; odd sizes edge-pad the last row/col.
+    cc = _pad_to_multiple_np(ycc[..., 1:], 2)
+    n, hp, wp, _ = cc.shape
+    cc = cc.reshape(n, hp // 2, 2, wp // 2, 2, 2).mean(axis=(2, 4))
+    cc = np.clip(np.round(cc), 0, 255).astype(np.uint8)
+    return {"y": y, "cb": cc[..., 0], "cr": cc[..., 1]}
+
+
+def _encode_dct8(u8: np.ndarray) -> Dict[str, np.ndarray]:
+    x = _pad_to_multiple_np(np.asarray(u8, np.uint8), 8).astype(np.float32)
+    x -= 128.0
+    n, hp, wp, c = x.shape
+    blocks = x.reshape(n, hp // 8, 8, wp // 8, 8, c).transpose(0, 1, 3, 5, 2, 4)
+    a = DCT8_BASIS
+    z = DCT8_ZONE
+    # coeff[u, v] = sum_xy A[u, x] * A[v, y] * block[x, y]; keep the zone.
+    coef = np.einsum("ux,vy,...xy->...uv", a[:z], a[:z], blocks)
+    coef = coef.reshape(coef.shape[:-2] + (z * z,)) / DCT8_QUANT
+    coef = np.clip(np.round(coef), -127, 127).astype(np.int8)
+    return {"coef": coef}  # (N, nby, nbx, C, 16) int8
+
+
+# ---------------------------------------------------------------------------
+# Device-side decoders (jax, traced inside the cached step)
+# ---------------------------------------------------------------------------
+
+
+def decode(
+    codec: str,
+    payload,
+    height: int,
+    width: int,
+    *,
+    use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
+):
+    """Codec payload (batched, gathered) -> (B, H, W, 3) uint8 pixels.
+
+    jax; meant to be traced inside the cached step so decode fuses ahead
+    of ``fused_train_preprocess``. ``use_pallas`` (dct8 only) defaults to
+    the ``WATERNET_PALLAS=1`` gate; the lax fallback is bit-identical.
+    """
+    if codec == "raw":
+        return payload["raw"]
+    if codec == "yuv420":
+        return _decode_yuv420(payload, height, width)
+    if codec == "dct8":
+        return _decode_dct8(
+            payload, height, width, use_pallas=use_pallas, interpret=interpret
+        )
+    raise ValueError(f"unknown cache codec {codec!r} (choose from {CODECS})")
+
+
+def _decode_yuv420(payload, height: int, width: int):
+    import jax.numpy as jnp
+
+    y = payload["y"].astype(jnp.float32)
+    # Nearest-neighbour 2x chroma upsample, cropped to the luma grid.
+    def up(p):
+        p = jnp.repeat(jnp.repeat(p, 2, axis=1), 2, axis=2)
+        return p[:, :height, :width].astype(jnp.float32) - 128.0
+
+    ycc = jnp.stack([y, up(payload["cb"]), up(payload["cr"])], axis=-1)
+    rgb = ycc @ jnp.asarray(_YCBCR_INV.T)
+    return jnp.clip(jnp.round(rgb), 0, 255).astype(jnp.uint8)
+
+
+def _decode_dct8(
+    payload,
+    height: int,
+    width: int,
+    *,
+    use_pallas: Optional[bool] = None,
+    interpret: Optional[bool] = None,
+):
+    import jax.numpy as jnp
+
+    from waternet_tpu.ops import pallas_kernels as pk
+
+    coef = payload["coef"]  # (B, nby, nbx, C, 16) int8
+    b, nby, nbx, c, z2 = coef.shape
+    flat = coef.reshape(b * nby * nbx * c, z2)
+    if use_pallas is None:
+        use_pallas = pk.pallas_enabled()
+    if use_pallas:
+        pix = pk.dct8_dequant_idct(
+            flat,
+            jnp.asarray(DCT8_QUANT),
+            jnp.asarray(DCT8_IDCT_MATRIX),
+            interpret=interpret,
+        )
+    else:
+        deq = flat.astype(jnp.float32) * jnp.asarray(DCT8_QUANT)
+        import jax
+
+        pix = jax.lax.dot_general(
+            deq,
+            jnp.asarray(DCT8_IDCT_MATRIX),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (blocks, 64)
+    img = pix.reshape(b, nby, nbx, c, 8, 8).transpose(0, 1, 4, 2, 5, 3)
+    img = img.reshape(b, nby * 8, nbx * 8, c)[:, :height, :width]
+    return jnp.clip(jnp.round(img + 128.0), 0, 255).astype(jnp.uint8)
+
+
+def roundtrip(codec: str, u8: np.ndarray) -> np.ndarray:
+    """Host-side encode -> device decode -> host uint8 (tests, bench,
+    PSNR reporting). For ``raw`` this is the identity."""
+    import jax
+
+    u8 = np.asarray(u8, np.uint8)
+    payload = {k: jax.numpy.asarray(v) for k, v in encode(codec, u8).items()}
+    out = decode(codec, payload, u8.shape[1], u8.shape[2])
+    return np.asarray(jax.device_get(out))
+
+
+def psnr_db(a_u8: np.ndarray, b_u8: np.ndarray) -> float:
+    """Peak signal-to-noise ratio between two uint8 arrays, in dB
+    (``inf`` for identical arrays)."""
+    a = np.asarray(a_u8, np.float64)
+    b = np.asarray(b_u8, np.float64)
+    mse = float(np.mean((a - b) ** 2))
+    if mse == 0.0:
+        return float("inf")
+    return 10.0 * np.log10(255.0**2 / mse)
+
+
+# ---------------------------------------------------------------------------
+# Preflight HBM budgeter
+# ---------------------------------------------------------------------------
+
+
+def encoded_bytes_per_image(codec: str, height: int, width: int) -> int:
+    """Encoded bytes for ONE (H, W, 3) image under ``codec``."""
+    if codec == "raw":
+        return height * width * 3
+    if codec == "yuv420":
+        ch, cw = -(-height // 2), -(-width // 2)
+        return height * width + 2 * ch * cw
+    if codec == "dct8":
+        nby, nbx = -(-height // 8), -(-width // 8)
+        return nby * nbx * 3 * DCT8_ZONE * DCT8_ZONE
+    raise ValueError(f"unknown cache codec {codec!r} (choose from {CODECS})")
+
+
+def decode_flops_per_image(codec: str, height: int, width: int) -> int:
+    """Approximate in-step decode FLOPs per image (0 for raw)."""
+    if codec == "raw":
+        return 0
+    if codec == "yuv420":
+        # 3x3 matrix per pixel: 9 mul + 6 add, plus the chroma shift.
+        return height * width * 17
+    if codec == "dct8":
+        nby, nbx = -(-height // 8), -(-width // 8)
+        z2 = DCT8_ZONE * DCT8_ZONE
+        # Dequant (16) + (16 -> 64) matmul (2*16*64) per block-channel.
+        return nby * nbx * 3 * (z2 + 2 * z2 * 64)
+    raise ValueError(f"unknown cache codec {codec!r} (choose from {CODECS})")
+
+
+def estimate_cache_bytes(
+    codec: str,
+    n_items: int,
+    height: int,
+    width: int,
+    *,
+    precache_histeq: bool = False,
+    precache_vgg_ref: bool = False,
+    vgg_ref_bytes_per_item: int = 0,
+) -> int:
+    """Resident HBM bytes for an ``n_items``-pair cache under ``codec``.
+
+    Counts raw+ref; the ``raw`` codec additionally counts the
+    precache_histeq WB/GC planes and the dihedral CLAHE variant table
+    (and, when enabled, the precache_vgg_ref feature table) — those
+    tables ride ONLY the raw codec (a lossy cache decodes pixels in-step
+    and computes transforms there, see TrainerConfig.cache_codec).
+    """
+    per_pair = 2 * encoded_bytes_per_image(codec, height, width)
+    total = n_items * per_pair
+    if codec == "raw" and precache_histeq:
+        from waternet_tpu.data.augment import dihedral_variant_count
+
+        n_var = dihedral_variant_count(height, width)
+        total += n_items * (2 + n_var) * height * width * 3
+        if precache_vgg_ref:
+            total += n_items * n_var * vgg_ref_bytes_per_item
+    return total
+
+
+def resolve_headroom(device=None) -> Optional[int]:
+    """Allocatable HBM bytes for a cache, or None when unknowable (CPU).
+
+    ``WATERNET_CACHE_HEADROOM_BYTES`` overrides the live number — tests
+    and the bench's capped-headroom A/B arm use it to exercise the
+    budgeter without real HBM pressure. Live resolution:
+    ``bytes_limit - bytes_in_use`` from PJRT ``memory_stats()``.
+    """
+    env = os.environ.get("WATERNET_CACHE_HEADROOM_BYTES")
+    if env:
+        return int(env)
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    from waternet_tpu.obs.device import hbm_stats
+
+    stats = hbm_stats(device)
+    if stats is None or stats.get("bytes_limit") is None:
+        return None
+    return int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
+
+
+def budget_report(
+    n_items: int,
+    height: int,
+    width: int,
+    *,
+    headroom: Optional[int],
+    precache_histeq: bool = False,
+    precache_vgg_ref: bool = False,
+    vgg_ref_bytes_per_item: int = 0,
+) -> List[dict]:
+    """Per-codec decision rows, cheapest-decode first (the ladder order).
+
+    ``fits`` is None when headroom is unknowable (CPU backends without
+    ``memory_stats()``): the budgeter then trusts the caller's choice.
+    """
+    budget = None if headroom is None else int(headroom * HEADROOM_SAFETY)
+    rows = []
+    for codec in CODECS:
+        nbytes = estimate_cache_bytes(
+            codec,
+            n_items,
+            height,
+            width,
+            precache_histeq=precache_histeq,
+            precache_vgg_ref=precache_vgg_ref,
+            vgg_ref_bytes_per_item=vgg_ref_bytes_per_item,
+        )
+        raw_pair = 2 * n_items * height * width * 3
+        rows.append(
+            {
+                "codec": codec,
+                "cache_bytes": nbytes,
+                "compression_ratio": raw_pair / max(
+                    2 * n_items * encoded_bytes_per_image(codec, height, width),
+                    1,
+                ),
+                "decode_flops_per_image": decode_flops_per_image(
+                    codec, height, width
+                ),
+                "fits": None if budget is None else nbytes <= budget,
+            }
+        )
+    return rows
+
+
+def choose_codec(
+    requested: str,
+    n_items: int,
+    height: int,
+    width: int,
+    *,
+    headroom: Optional[int],
+    precache_histeq: bool = False,
+    precache_vgg_ref: bool = False,
+    vgg_ref_bytes_per_item: int = 0,
+) -> dict:
+    """Resolve ``requested`` (a codec name or ``auto``) against headroom.
+
+    Returns the chosen codec's report row. ``auto`` picks the FIRST
+    ladder codec that fits (raw -> yuv420 -> dct8: cheapest decode wins;
+    unknowable headroom picks raw, today's behaviour). A named codec
+    that provably does not fit — and an ``auto`` where nothing fits —
+    raise :class:`CacheBudgetError` with the sizes and, when one exists,
+    the codec that would fit.
+    """
+    if requested != "auto" and requested not in CODECS:
+        raise ValueError(
+            f"unknown cache codec {requested!r} "
+            f"(choose from {CODECS + ('auto',)})"
+        )
+    rows = budget_report(
+        n_items,
+        height,
+        width,
+        headroom=headroom,
+        precache_histeq=precache_histeq,
+        precache_vgg_ref=precache_vgg_ref,
+        vgg_ref_bytes_per_item=vgg_ref_bytes_per_item,
+    )
+    by_codec = {r["codec"]: r for r in rows}
+    fitting = [r for r in rows if r["fits"]]
+    if requested == "auto":
+        if headroom is None:
+            return by_codec["raw"]
+        if fitting:
+            return fitting[0]
+        raise CacheBudgetError(
+            f"no cache codec fits: {n_items} pairs at {height}x{width} need "
+            + ", ".join(
+                f"{r['codec']}={_fmt_bytes(r['cache_bytes'])}" for r in rows
+            )
+            + f" against {_fmt_bytes(headroom)} HBM headroom "
+            f"(x{HEADROOM_SAFETY:g} safety) — shrink the dataset or "
+            "image size, or train host-fed (drop --device-cache)"
+        )
+    row = by_codec[requested]
+    if row["fits"] is False:
+        hint = (
+            f"; --cache-codec {fitting[0]['codec']} "
+            f"({_fmt_bytes(fitting[0]['cache_bytes'])}) would fit"
+            if fitting
+            else "; no codec fits — shrink the dataset or image size"
+        )
+        raise CacheBudgetError(
+            f"device cache codec {requested!r} does not fit: {n_items} pairs "
+            f"at {height}x{width} need {_fmt_bytes(row['cache_bytes'])} "
+            f"against {_fmt_bytes(headroom)} HBM headroom "
+            f"(x{HEADROOM_SAFETY:g} safety){hint}"
+        )
+    return row
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{int(v)} B"
+        v /= 1024.0
+    return f"{int(n)} B"
+
+
+def report_lines(rows: List[dict], headroom: Optional[int]) -> List[str]:
+    """Human-readable ``--cache-report`` table (one string per line)."""
+    head = (
+        f"{'codec':<8} {'cache bytes':>12} {'ratio':>6} "
+        f"{'decode MFLOP/img':>16} {'fits':>5}"
+    )
+    lines = [
+        "device-cache budget (headroom: "
+        + (_fmt_bytes(headroom) if headroom is not None else "unknown")
+        + f", safety x{HEADROOM_SAFETY:g})",
+        head,
+    ]
+    for r in rows:
+        fits = "?" if r["fits"] is None else ("yes" if r["fits"] else "NO")
+        lines.append(
+            f"{r['codec']:<8} {_fmt_bytes(r['cache_bytes']):>12} "
+            f"{r['compression_ratio']:>6.2f} "
+            f"{r['decode_flops_per_image'] / 1e6:>16.2f} {fits:>5}"
+        )
+    return lines

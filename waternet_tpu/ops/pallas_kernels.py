@@ -6,7 +6,7 @@ XLA does *not* do well on TPU is the scatter-add at the heart of CLAHE's
 per-tile histograms (`waternet_tpu.ops.clahe` uses ``jnp.bincount``, which
 lowers to a serialized scatter) and the HBM byte stream of the one-hot
 LUT-interpolation matmul (~1 GB/frame at 1080p — the round-5 hog,
-docs/CLAHE_1080.md). Three kernels:
+docs/CLAHE_1080.md). Four kernels:
 
 * :func:`tile_histogram` — per-tile histograms as a comparison-matrix
   reduction on the VPU::
@@ -34,6 +34,13 @@ docs/CLAHE_1080.md). Three kernels:
   strategies — measured: an in-kernel blend contracts differently and
   flips round() ties by 1 level on ~3e-4 of pixels. Result: bit-identical
   to both lax interpolation strategies.
+* :func:`dct8_dequant_idct` — the device-cache codec's decode hot loop
+  (``--cache-codec dct8``, waternet_tpu/data/codec.py): dequantize the
+  int8 zonal DCT coefficients and apply the inverse transform as one
+  VMEM-blocked ``(blocks, Z2) @ (Z2, 64)`` matmul per grid step, the
+  identical ``dot_general`` contraction as the lax fallback — decode
+  stays bit-identical with the gate on or off (pinned across odd image
+  sizes in tests/test_codec.py).
 
 Enabled via ``WATERNET_PALLAS=1`` (or ``use_pallas=True`` arguments); the
 default stays the XLA path until the kernels are profiled on real
@@ -308,3 +315,70 @@ def clahe_lut_planes(
         cell_h=int(cell_h), cell_w=int(cell_w), interpret=interpret,
     )
     return planes[0], planes[1], planes[2], planes[3]
+
+
+# ---------------------------------------------------------------------------
+# Device-cache codec: fused dct8 dequantize + inverse DCT
+# ---------------------------------------------------------------------------
+
+# Coefficient blocks per grid step. (CHUNK, 64) f32 output block = 128 KB
+# at 512 — tiny next to VMEM; the (Z2, 64) IDCT matrix and (1, Z2) quant
+# row are broadcast constants.
+_DCT_CHUNK = 512
+
+
+def _dct8_kernel(coef_ref, q_ref, m_ref, out_ref):
+    """Grid: (n_chunks,). One chunk of 8x8 block-channels: dequantize and
+    inverse-transform as a single dot — the same ``dot_general``
+    contraction the lax fallback in waternet_tpu/data/codec.py runs, so
+    the two paths stay bit-identical."""
+    deq = coef_ref[:].astype(jnp.float32) * q_ref[:]
+    out_ref[:] = jax.lax.dot_general(
+        deq,
+        m_ref[:],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _dct8_idct_impl(coef, quant, idct_m, interpret):
+    nb, z2 = coef.shape
+    n_chunks = -(-nb // _DCT_CHUNK)
+    pad = n_chunks * _DCT_CHUNK - nb
+    if pad:
+        coef = jnp.pad(coef, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        _dct8_kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((_DCT_CHUNK, z2), lambda i: (i, 0)),
+            pl.BlockSpec((1, z2), lambda i: (0, 0)),
+            pl.BlockSpec((z2, 64), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((_DCT_CHUNK, 64), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_chunks * _DCT_CHUNK, 64), jnp.float32),
+        interpret=interpret,
+    )(coef, quant.reshape(1, z2), idct_m)
+    return out[:nb]
+
+
+def dct8_dequant_idct(
+    coef: jnp.ndarray,
+    quant: jnp.ndarray,
+    idct_m: jnp.ndarray,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """(NB, Z2) int8 zonal DCT coefficients -> (NB, 64) float32 pixel
+    blocks (level-shifted; the caller adds 128 and casts).
+
+    ``quant`` is the flat (Z2,) dequantization table and ``idct_m`` the
+    (Z2, 64) kept-coefficients -> pixels matrix
+    (``codec.DCT8_IDCT_MATRIX``) — passed in rather than imported so this
+    module stays a generic kernel library. Bit-identical to the lax
+    ``dot_general`` fallback in :func:`waternet_tpu.data.codec.decode`
+    (pinned in tests/test_codec.py across odd sizes).
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    return _dct8_idct_impl(coef, quant, idct_m, interpret)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import optax
 from flax import struct
 
+from waternet_tpu.data import codec as cachecodec
 from waternet_tpu.data.augment import (
     apply_augment_batch,
     dihedral_apply,
@@ -185,6 +186,19 @@ class TrainConfig:
     distill: bool = False
     student_width: int = 24
     student_depth: int = 7
+    # Device-cache storage codec (waternet_tpu/data/codec.py): how
+    # cache_dataset() stores the dataset in HBM. "raw" is today's uint8
+    # path — bit-exact, keeps the precache_histeq/vgg_ref tables.
+    # "yuv420" (2x) and "dct8" (4x) store compressed planes and decode
+    # them INSIDE the cached step, fused ahead of fused_train_preprocess
+    # — full-res datasets that outgrow HBM raw fit compressed. Lossy
+    # codecs skip the precache tables (an 8-variant CLAHE table of
+    # decoded pixels would cost ~5x the raw cache and defeat the point);
+    # the step computes transforms on the decoded uint8 batch instead.
+    # "auto" asks cache_dataset()'s preflight budgeter to pick the
+    # cheapest-decode codec whose estimated bytes fit the live HBM
+    # headroom. Only affects the cached path.
+    cache_codec: str = "raw"
 
     @property
     def dtype(self):
@@ -704,6 +718,33 @@ class TrainingEngine:
                 ref_feats, idx, n_real,
             )
 
+        def _decode_cached(enc, idx):
+            """Gather the encoded batch and decode it in-step (lossy
+            cache_codec): per-plane index gather from the HBM-resident
+            payload, then the codec's on-device decode to uint8 pixels —
+            all inside the one step program, so decode fuses ahead of
+            fused_train_preprocess and only the BATCH is ever decoded.
+            The decoded uint8 feeds the same train/eval step body as the
+            raw cache, so parity with a host round-trip is exact."""
+            codec = self.config.cache_codec
+            h, w = self._cache_hw
+            raw_p = {k: jnp.take(v, idx, axis=0) for k, v in enc["raw"].items()}
+            ref_p = {k: jnp.take(v, idx, axis=0) for k, v in enc["ref"].items()}
+            raw = cachecodec.decode(codec, raw_p, h, w)
+            ref = cachecodec.decode(codec, ref_p, h, w)
+            return (
+                jax.lax.with_sharding_constraint(raw, bsh),
+                jax.lax.with_sharding_constraint(ref, bsh),
+            )
+
+        def train_step_cached_codec(state: TrainStateT, enc, idx, rng, n_real):
+            raw_u8, ref_u8 = _decode_cached(enc, idx)
+            return train_step(state, raw_u8, ref_u8, rng, n_real)
+
+        def eval_step_cached_codec(state: TrainStateT, enc, idx, n_real):
+            raw_u8, ref_u8 = _decode_cached(enc, idx)
+            return eval_step(state, raw_u8, ref_u8, n_real)
+
         self.train_step = jax.jit(
             train_step,
             in_shardings=(rep, bsh, bsh, rep, rep),
@@ -754,6 +795,19 @@ class TrainingEngine:
         self.eval_step_cached_pre_vggref = jax.jit(
             eval_step_cached_pre_vggref,
             in_shardings=(rep,) * 9,
+            out_shardings=rep,
+        )
+        # Codec steps take the encoded payload as a pytree (dict of
+        # planes); `rep` broadcasts over it as a sharding prefix.
+        self.train_step_cached_codec = jax.jit(
+            train_step_cached_codec,
+            in_shardings=(rep, rep, rep, rep, rep),
+            out_shardings=(rep, rep),
+            donate_argnums=(0,),
+        )
+        self.eval_step_cached_codec = jax.jit(
+            eval_step_cached_codec,
+            in_shardings=(rep, rep, rep, rep),
             out_shardings=rep,
         )
 
@@ -868,7 +922,25 @@ class TrainingEngine:
         path; with ``precache_histeq`` (default) the classical transforms
         are additionally hoisted out of the step into precomputed caches —
         still bit-identical (see TrainConfig.precache_histeq).
+
+        ``config.cache_codec`` selects the at-rest representation
+        (waternet_tpu/data/codec.py): lossy codecs store compressed
+        planes and the step decodes its gathered batch on device —
+        full-res datasets that outgrow HBM raw fit compressed. A
+        preflight budgeter sizes every build against the live HBM
+        headroom FIRST, so a dataset that cannot fit dies with a sized
+        message naming the codec that would fit instead of a bare
+        allocator error mid-build; ``cache_codec="auto"`` lets it pick.
         """
+        if self.config.precache_vgg_ref and self.config.cache_codec != "raw":
+            # The feature table rides the raw cache's dihedral machinery;
+            # building it over decoded pixels would multiply resident
+            # bytes past the raw cache and silently defeat the codec.
+            raise ValueError(
+                "precache_vgg_ref requires cache_codec='raw': the "
+                "feature table is precomputed from the raw-resident ref "
+                "and would defeat a compressed cache"
+            )
         if self.config.precache_vgg_ref and self.config.distill:
             # The precached table holds vgg(ground-truth ref); the
             # distillation target is the teacher OUTPUT, whose features
@@ -893,13 +965,112 @@ class TrainingEngine:
                 "precache_vgg_ref requires precache_histeq=True, "
                 "host_preprocess=False, and a nonzero perceptual_weight"
             )
-        self._cache_raw, self._cache_ref = self._build_cache(dataset, indices)
+        codec = self._preflight_cache_budget(len(indices))
+        self._cache_enc = None
+        self._cache_raw = self._cache_ref = None
         self._cache_wb = self._cache_gc = self._cache_he = None
         self._cache_vgg_ref = None
-        if self.config.precache_histeq and not self.config.host_preprocess:
-            self._build_transform_cache()
-            if self.config.precache_vgg_ref:
-                self._build_vgg_ref_cache()
+        if codec == "raw":
+            self._cache_raw, self._cache_ref = self._build_cache(
+                dataset, indices
+            )
+            self._cache_hw = (
+                int(self._cache_raw.shape[1]),
+                int(self._cache_raw.shape[2]),
+            )
+            self._cache_len = int(self._cache_raw.shape[0])
+            if self.config.precache_histeq and not self.config.host_preprocess:
+                self._build_transform_cache()
+                if self.config.precache_vgg_ref:
+                    self._build_vgg_ref_cache()
+        else:
+            self._build_codec_cache(dataset, indices, codec)
+
+    def _preflight_cache_budget(self, n_items: int) -> str:
+        """Size the requested cache against live HBM headroom BEFORE
+        loading a byte; resolves ``cache_codec="auto"`` to a concrete
+        codec (mutating the config so the compiled step and config.json
+        see the choice). Raises :class:`~waternet_tpu.data.codec.
+        CacheBudgetError` — sized, naming the codec that would fit —
+        where the old path died with a bare allocator error mid-build."""
+        h, w = self.config.im_height, self.config.im_width
+        feat_bytes = (
+            (h // 16) * (w // 16) * 512
+            * (2 if self.config.precision == "bf16" else 4)
+        )
+        row = cachecodec.choose_codec(
+            self.config.cache_codec,
+            n_items,
+            h,
+            w,
+            headroom=cachecodec.resolve_headroom(self.mesh.devices.flat[0]),
+            precache_histeq=(
+                self.config.precache_histeq
+                and not self.config.host_preprocess
+            ),
+            precache_vgg_ref=self.config.precache_vgg_ref,
+            vgg_ref_bytes_per_item=feat_bytes,
+        )
+        self.config.cache_codec = row["codec"]
+        return row["codec"]
+
+    def _build_codec_cache(self, dataset, indices, codec: str) -> None:
+        """Encode (raw, ref) under ``codec`` on host and pin the encoded
+        planes in HBM; the step gathers + decodes per batch
+        (train_step_cached_codec)."""
+        import numpy as np
+
+        pairs = [dataset.load_pair(int(i)) for i in indices]
+        raw_np = np.stack([p[0] for p in pairs])
+        ref_np = np.stack([p[1] for p in pairs])
+        self._cache_enc = {
+            "raw": {
+                k: self._replicate_global(v)
+                for k, v in cachecodec.encode(codec, raw_np).items()
+            },
+            "ref": {
+                k: self._replicate_global(v)
+                for k, v in cachecodec.encode(codec, ref_np).items()
+            },
+        }
+        self._cache_hw = (int(raw_np.shape[1]), int(raw_np.shape[2]))
+        self._cache_len = int(raw_np.shape[0])
+
+    def _has_cache(self) -> bool:
+        return (
+            getattr(self, "_cache_raw", None) is not None
+            or getattr(self, "_cache_enc", None) is not None
+        )
+
+    def cache_resident_bytes(self):
+        """Total HBM bytes pinned by the training cache (encoded planes
+        plus any precache tables), or None when no cache is built.
+        Host-side metadata only — no device sync."""
+        if not self._has_cache():
+            return None
+        arrs = []
+        if getattr(self, "_cache_enc", None) is not None:
+            for side in self._cache_enc.values():
+                arrs.extend(side.values())
+        else:
+            arrs = [
+                a
+                for a in (
+                    self._cache_raw, self._cache_ref,
+                    getattr(self, "_cache_wb", None),
+                    getattr(self, "_cache_gc", None),
+                    getattr(self, "_cache_he", None),
+                    getattr(self, "_cache_vgg_ref", None),
+                )
+                if a is not None
+            ]
+        total = 0
+        for a in arrs:
+            n = 1
+            for d in a.shape:
+                n *= int(d)
+            total += n * a.dtype.itemsize
+        return total
 
     def _transform_tables(self, raw, n_var: int):
         """(wb, gc, he[variants]) uint8 numpy tables for a (N, H, W, C)
@@ -1095,8 +1266,10 @@ class TrainingEngine:
         and :meth:`train_epoch_cached` both resolve through here, so the
         benchmark can never measure a different program than training
         runs. Callers append ``(idx, rng, n_real)`` to ``cache_args``."""
-        if getattr(self, "_cache_raw", None) is None:
+        if not self._has_cache():
             raise RuntimeError("call cache_dataset() before cached_train_step()")
+        if getattr(self, "_cache_enc", None) is not None:
+            return self.train_step_cached_codec, (self._cache_enc,)
         if getattr(self, "_cache_vgg_ref", None) is not None:
             return self.train_step_cached_pre_vggref, (
                 self._cache_raw, self._cache_ref, self._cache_wb,
@@ -1115,7 +1288,7 @@ class TrainingEngine:
         """One epoch over the cached dataset; same metric contract as
         :meth:`train_epoch`. Requires :meth:`cache_dataset` first.
         ``start_batch``/``control``/``carry`` as in :meth:`train_epoch`."""
-        if getattr(self, "_cache_raw", None) is None:
+        if not self._has_cache():
             raise RuntimeError("call cache_dataset() before train_epoch_cached()")
         if self.config.host_preprocess:
             raise RuntimeError(
@@ -1123,12 +1296,10 @@ class TrainingEngine:
                 "(host_preprocess=False)"
             )
         base_rng = jax.random.PRNGKey(self.config.seed + 1)
-        n = self._cache_raw.shape[0]
+        n = self._cache_len
         # Index payloads carry no pixels; seed the MFU plane from the
         # cache shape (host metadata — no fetch).
-        self.perf.seed_flops(
-            int(self._cache_raw.shape[1]), int(self._cache_raw.shape[2])
-        )
+        self.perf.seed_flops(*self._cache_hw)
 
         def payloads():
             batches = self._cached_index_batches(n, epoch, self.config.shuffle)
@@ -1157,7 +1328,14 @@ class TrainingEngine:
         a different dataset or index set rebuilds it. Identity comes from
         :func:`_cache_token`, not ``id()``: CPython reuses object ids after
         GC, so a freed dataset replaced by a new same-indexed one at the
-        same address must not serve the stale cache."""
+        same address must not serve the stale cache.
+
+        Explicit val caches are always stored raw regardless of
+        ``cache_codec``: the val split is ~10% of train, so compression
+        buys little there, and raw keeps eval metrics codec-independent.
+        Eval over the TRAIN cache (``dataset=None``) reads whatever the
+        train cache holds — decoded in-step for lossy codecs."""
+        enc = None
         if dataset is not None:
             key = (_cache_token(dataset), tuple(int(i) for i in indices))
             if getattr(self, "_val_cache_key", None) != key:
@@ -1169,17 +1347,23 @@ class TrainingEngine:
             cache_raw, cache_ref = self._val_cache
             pre = self._val_cache_pre
         else:
-            if getattr(self, "_cache_raw", None) is None:
+            if not self._has_cache():
                 raise RuntimeError("no cached dataset for eval_epoch_cached()")
-            cache_raw, cache_ref = self._cache_raw, self._cache_ref
-            pre = self._train_eval_pre_tables()
+            if getattr(self, "_cache_enc", None) is not None:
+                enc = self._cache_enc
+                cache_raw = cache_ref = pre = None
+            else:
+                cache_raw, cache_ref = self._cache_raw, self._cache_ref
+                pre = self._train_eval_pre_tables()
         sums = {k: 0.0 for k in VAL_METRICS_NAMES}
         count = 0
         pending = []
-        n = cache_raw.shape[0]
+        n = self._cache_len if enc is not None else cache_raw.shape[0]
         for idx, n_real in self._cached_index_batches(n, epoch=0, shuffle=False):
             idx_g = self._replicate_global(idx)
-            if pre is None:
+            if enc is not None:
+                m = self.eval_step_cached_codec(self.state, enc, idx_g, n_real)
+            elif pre is None:
                 m = self.eval_step_cached(
                     self.state, cache_raw, cache_ref, idx_g, n_real
                 )
